@@ -1,0 +1,32 @@
+"""lightgbm_tpu: a TPU-native gradient-boosting (GBDT) framework.
+
+A from-scratch re-design of LightGBM's capabilities for TPUs: JAX/XLA/Pallas
+compute (one-hot MXU histograms, single-program leaf-wise tree growth,
+``shard_map`` collectives for distributed training) behind the familiar
+LightGBM Python API surface (``Dataset``/``Booster``/``train``/``cv``/sklearn
+wrappers).
+"""
+from .basic import Booster, Dataset
+from .callback import early_stopping, print_evaluation, log_evaluation, \
+    record_evaluation, reset_parameter
+from .config import Config
+from .engine import CVBooster, cv, train
+from .utils.log import LightGBMError, register_log_callback
+
+__version__ = "0.1.0"
+
+__all__ = ["Booster", "Dataset", "Config", "CVBooster", "cv", "train",
+           "LightGBMError", "register_log_callback", "early_stopping",
+           "print_evaluation", "log_evaluation", "record_evaluation",
+           "reset_parameter", "__version__"]
+
+
+def __getattr__(name):
+    # lazy imports for optional API surfaces
+    if name in ("LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"):
+        from . import sklearn as _sk
+        return getattr(_sk, name)
+    if name == "plot_importance" or name.startswith("plot_"):
+        from . import plotting as _pl
+        return getattr(_pl, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

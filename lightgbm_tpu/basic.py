@@ -1,0 +1,330 @@
+"""Python-facing core objects: ``Dataset`` and ``Booster``.
+
+API-parity layer mirroring the reference's ``python-package/lightgbm/basic.py``
+(``Dataset`` :935, ``Booster`` :2043) — but there is no ctypes/C-ABI boundary:
+the engine is the in-process JAX ``GBDT``.  Lazy Dataset construction,
+reference alignment for validation data, field get/set, model IO, and the
+predict family keep the same surface.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .io.dataset import Dataset as _InnerDataset
+from .models.gbdt import GBDT
+from .models import model_io
+from .utils.log import Log, check, LightGBMError
+
+__all__ = ["Dataset", "Booster", "LightGBMError"]
+
+
+class Dataset:
+    """Lazily-constructed dataset (reference ``basic.py:935``)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List[int], List[str]] = "auto",
+                 params: Optional[Dict[str, Any]] = None, free_raw_data: bool = True):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._inner: Optional[_InnerDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+        self._predictor = None
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._inner is not None:
+            return self
+        cfg = Config.from_params(self.params)
+        data = self.data
+        if isinstance(data, str):
+            from .io.loader import load_file
+            data, label, feat_names = load_file(data, cfg)
+            if self.label is None:
+                self.label = label
+            if self.feature_name == "auto" and feat_names:
+                self.feature_name = feat_names
+        feature_names = None if self.feature_name == "auto" else list(self.feature_name)
+        cats = None
+        if self.categorical_feature != "auto":
+            cats = self.categorical_feature
+        ref_inner = None
+        if self.reference is not None:
+            ref_inner = self.reference.construct()._inner
+        if self.used_indices is not None and ref_inner is not None:
+            self._inner = ref_inner.subset(self.used_indices)
+            if self.label is not None:
+                self._inner.metadata.set_field("label", np.asarray(self.label)[self.used_indices] if len(np.asarray(self.label)) != len(self.used_indices) else self.label)
+        else:
+            # resolve categorical feature names -> indices
+            if cats is not None and feature_names is not None:
+                cats = [feature_names.index(c) if isinstance(c, str) else c for c in cats]
+            self._inner = _InnerDataset.from_data(
+                np.asarray(data, dtype=np.float64) if not hasattr(data, "values") else data,
+                cfg, label=self.label, weight=self.weight, group=self.group,
+                init_score=self.init_score, categorical_feature=cats,
+                feature_names=feature_names, reference=ref_inner)
+        if self.free_raw_data and not isinstance(self.data, str):
+            pass  # keep raw for sklearn compat; TPU copy is the binned matrix
+        return self
+
+    # ------------------------------------------------------------------
+    def set_field(self, name: str, data) -> None:
+        self.construct()
+        self._inner.metadata.set_field(name, data)
+
+    def get_field(self, name: str):
+        self.construct()
+        return self._inner.metadata.get_field(name)
+
+    def set_label(self, label) -> None:
+        self.label = label
+        if self._inner is not None:
+            self._inner.metadata.set_field("label", label)
+
+    def set_weight(self, weight) -> None:
+        self.weight = weight
+        if self._inner is not None:
+            self._inner.metadata.set_field("weight", weight)
+
+    def set_group(self, group) -> None:
+        self.group = group
+        if self._inner is not None:
+            self._inner.metadata.set_field("group", group)
+
+    def set_init_score(self, init_score) -> None:
+        self.init_score = init_score
+        if self._inner is not None:
+            self._inner.metadata.set_field("init_score", init_score)
+
+    def get_label(self):
+        return self.get_field("label")
+
+    def get_weight(self):
+        return self.get_field("weight")
+
+    def get_group(self):
+        qb = self.get_field("group")
+        return None if qb is None else np.diff(qb)
+
+    def get_init_score(self):
+        return self.get_field("init_score")
+
+    def num_data(self) -> int:
+        self.construct()
+        return self._inner.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self._inner.num_total_features
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self._inner.feature_names)
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params)
+
+    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+        ds = Dataset(None, reference=self, params=params or self.params)
+        ds.used_indices = np.asarray(used_indices, dtype=np.int64)
+        return ds
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self.construct()
+        self._inner.save_binary(filename)
+        return self
+
+    def num_bins_total(self) -> int:
+        self.construct()
+        return int(sum(self._inner.num_bin(i) for i in range(self._inner.num_features)))
+
+
+class Booster:
+    """Training/prediction handle (reference ``basic.py:2043``)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = dict(params or {})
+        self.train_set = train_set
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._network_initialized = False
+        if train_set is not None:
+            check(isinstance(train_set, Dataset), "training data should be Dataset instance")
+            cfg = Config.from_params(self.params)
+            train_set.params = dict(self.params)
+            train_set.construct()
+            self._gbdt = self._create_engine(cfg, train_set._inner)
+            self.name_valid_sets: List[str] = []
+        elif model_file is not None:
+            with open(model_file) as f:
+                self._load_from_string(f.read())
+        elif model_str is not None:
+            self._load_from_string(model_str)
+        else:
+            raise LightGBMError("need at least one of train_set / model_file / model_str")
+
+    @staticmethod
+    def _create_engine(cfg: Config, inner_train):
+        from .models.dart import DART
+        from .models.goss import GOSS
+        from .models.rf import RF
+        cls = {"gbdt": GBDT, "dart": DART, "goss": GOSS, "rf": RF}[cfg.boosting]
+        return cls(cfg, inner_train)
+
+    def _load_from_string(self, model_str: str) -> None:
+        self._gbdt = model_io.load_model_from_string(model_str, GBDT)
+        self.name_valid_sets = []
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.params = dict(self.params)
+        data.construct()
+        self._gbdt.add_valid_data(data._inner, name)
+        self.name_valid_sets.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; returns True if stopped (no splits)
+        (reference ``Booster.update``, ``basic.py:2448``)."""
+        if train_set is not None:
+            raise LightGBMError("resetting train_set after construction is not supported yet")
+        if fobj is not None:
+            K = self._gbdt.num_tree_per_iteration
+            score = self.__inner_raw_score()
+            grad, hess = fobj(score, self.train_set)
+            return self._gbdt.train_one_iter(np.asarray(grad), np.asarray(hess))
+        return self._gbdt.train_one_iter()
+
+    def __inner_raw_score(self):
+        s = np.asarray(self._gbdt._train_score, np.float64)
+        return s[0] if self._gbdt.num_tree_per_iteration == 1 else s.T.reshape(-1)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        return self._gbdt.iter_
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        return self._gbdt.max_feature_idx + 1
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None):
+        return self._eval_set("training", -1, feval)
+
+    def eval_valid(self, feval=None):
+        out = []
+        for i in range(len(self.name_valid_sets)):
+            out.extend(self._eval_set(self.name_valid_sets[i], i, feval))
+        return out
+
+    def eval(self, data=None, name="eval", feval=None):
+        results = []
+        for ds_name, metric, val, hib in self._gbdt.eval_current():
+            results.append((ds_name, metric, val, hib))
+        return results
+
+    def _eval_set(self, name, idx, feval):
+        all_results = self._gbdt.eval_current()
+        out = [(n, m, v, h) for (n, m, v, h) in all_results if n == name]
+        if feval is not None:
+            if idx < 0:
+                score = np.asarray(self._gbdt._train_score, np.float64)
+                dataset = self.train_set
+            else:
+                score = np.asarray(self._gbdt._valid_scores[idx], np.float64)
+                dataset = None
+            s = score[0] if self._gbdt.num_tree_per_iteration == 1 else score
+            res = feval(s, dataset)
+            if isinstance(res, tuple):
+                res = [res]
+            for mname, val, hib in res:
+                out.append((name, mname, val, hib))
+        return out
+
+    # ------------------------------------------------------------------
+    def predict(self, data, start_iteration: int = 0, num_iteration: int = -1,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        if hasattr(data, "values"):
+            data = data.values
+        data = np.asarray(data, dtype=np.float64)
+        n_feat = self.num_feature()
+        data_feat = data.shape[1] if data.ndim == 2 else data.shape[0]
+        if data_feat != n_feat and not kwargs.get("predict_disable_shape_check", False):
+            raise LightGBMError(
+                f"The number of features in data ({data_feat}) is not the same "
+                f"as it was in training data ({n_feat}).\n"
+                "You can set ``predict_disable_shape_check=true`` to discard this error")
+        if pred_leaf:
+            return self._gbdt.predict_leaf_index(data, num_iteration)
+        if pred_contrib:
+            raise LightGBMError("pred_contrib (TreeSHAP) not yet implemented")
+        return self._gbdt.predict(data, num_iteration, start_iteration, raw_score)
+
+    # ------------------------------------------------------------------
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0, importance_type: str = "split") -> "Booster":
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration, start_iteration, importance_type))
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0, importance_type: str = "split") -> str:
+        return model_io.save_model_to_string(
+            self._gbdt, num_iteration if num_iteration is not None else -1,
+            start_iteration, 1 if importance_type == "gain" else 0)
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> dict:
+        g = self._gbdt
+        K = g.num_tree_per_iteration
+        models = g.models
+        return {
+            "name": "tree",
+            "version": "v3",
+            "num_class": g.num_class,
+            "num_tree_per_iteration": K,
+            "label_index": 0,
+            "max_feature_idx": g.max_feature_idx,
+            "objective": g.config.objective,
+            "feature_names": (g.train_data.feature_names if g.train_data else []),
+            "tree_info": [dict(tree_index=i, **t.to_json()) for i, t in enumerate(models)],
+        }
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        return self._gbdt.feature_importance(importance_type, iteration or -1)
+
+    def feature_name(self) -> List[str]:
+        if self._gbdt.train_data is not None:
+            return list(self._gbdt.train_data.feature_names)
+        return list(getattr(self._gbdt, "feature_names_", []))

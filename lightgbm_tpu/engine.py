@@ -1,0 +1,211 @@
+"""Training entry points: ``train()`` and ``cv()``
+(reference ``python-package/lightgbm/engine.py:15,392``)."""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import Config
+from .utils.log import Log, LightGBMError
+
+__all__ = ["train", "cv", "CVBooster"]
+
+
+def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj: Optional[Callable] = None, feval: Optional[Callable] = None,
+          init_model: Optional[str] = None, keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None,
+          early_stopping_rounds: Optional[int] = None,
+          verbose_eval: Any = True, evals_result: Optional[Dict] = None) -> Booster:
+    """Train a booster (reference ``engine.py:15``; loop at ``:230-270``)."""
+    params = dict(params or {})
+    # resolve aliases that control the loop itself
+    for alias in ("num_iterations", "num_iteration", "n_iter", "num_tree", "num_trees",
+                  "num_round", "num_rounds", "num_boost_round", "n_estimators"):
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+    for alias in ("early_stopping_round", "early_stopping_rounds", "early_stopping",
+                  "n_iter_no_change"):
+        if alias in params:
+            early_stopping_rounds = int(params.pop(alias))
+    if fobj is not None:
+        params["objective"] = "none"
+
+    if init_model is not None:
+        raise LightGBMError("init_model continued training lands with model IO round-trip work")
+
+    booster = Booster(params=params, train_set=train_set)
+    if valid_sets:
+        valid_names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
+        for vs, name in zip(valid_sets, valid_names):
+            if vs is train_set:
+                booster._gbdt.config.is_provide_training_metric = True
+                booster.name_valid_sets.append("training")
+                continue
+            booster.add_valid(vs, name)
+
+    cbs = list(callbacks or [])
+    if verbose_eval is True:
+        cbs.append(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval > 0:
+        cbs.append(callback_mod.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.append(callback_mod.early_stopping(early_stopping_rounds))
+    if evals_result is not None:
+        cbs.append(callback_mod.record_evaluation(evals_result))
+    cbs_before = [cb for cb in cbs if getattr(cb, "before_iteration", False)]
+    cbs_after = [cb for cb in cbs if not getattr(cb, "before_iteration", False)]
+    cbs_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(booster, params, i, 0, num_boost_round, None))
+        stopped = booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if booster._gbdt.valid_sets or booster._gbdt.config.is_provide_training_metric:
+            evaluation_result_list = booster._gbdt.eval_current()
+        if feval is not None:
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(booster, params, i, 0, num_boost_round,
+                                            evaluation_result_list))
+        except callback_mod.EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for name, metric, score, _ in (e.best_score or []):
+                booster.best_score.setdefault(name, {})[metric] = score
+            break
+        if stopped:
+            break
+    if booster.best_iteration < 0 and evaluation_result_list:
+        for name, metric, score, _ in evaluation_result_list:
+            booster.best_score.setdefault(name, {})[metric] = score
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (reference ``engine.py:278``)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, nfold: int, params, seed: int,
+                  stratified: bool, shuffle: bool):
+    full_data.construct()
+    num_data = full_data.num_data()
+    label = full_data.get_label()
+    rng = np.random.default_rng(seed)
+    if stratified and label is not None:
+        order = np.argsort(label, kind="stable")
+        if shuffle:
+            # shuffle within label groups to keep stratification
+            folds_assign = np.empty(num_data, np.int64)
+            folds_assign[order] = np.arange(num_data) % nfold
+            perm_map = rng.permutation(nfold)
+            folds_assign = perm_map[folds_assign]
+        else:
+            folds_assign = np.empty(num_data, np.int64)
+            folds_assign[order] = np.arange(num_data) % nfold
+    else:
+        idx = rng.permutation(num_data) if shuffle else np.arange(num_data)
+        folds_assign = np.empty(num_data, np.int64)
+        folds_assign[idx] = np.arange(num_data) % nfold
+    for k in range(nfold):
+        test_idx = np.where(folds_assign == k)[0]
+        train_idx = np.where(folds_assign != k)[0]
+        yield train_idx, test_idx
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       early_stopping_rounds: Optional[int] = None, seed: int = 0,
+       callbacks=None, eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """Cross-validation (reference ``engine.py:392``)."""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    if params.get("objective") in ("multiclass", "multiclassova") and not stratified:
+        pass
+    if params.get("objective") in (None, "regression") and stratified:
+        stratified = False
+
+    train_set.construct()
+    results: Dict[str, List[float]] = {}
+    cvbooster = CVBooster()
+
+    if folds is None:
+        folds = list(_make_n_folds(train_set, nfold, params, seed, stratified, shuffle))
+    elif hasattr(folds, "split"):
+        folds = list(folds.split(np.zeros(train_set.num_data()),
+                                 train_set.get_label()))
+
+    fold_boosters = []
+    for train_idx, test_idx in folds:
+        tr = train_set.subset(train_idx)
+        te = train_set.subset(test_idx)
+        bst = Booster(params=params, train_set=tr)
+        bst.add_valid(te, "valid")
+        fold_boosters.append(bst)
+        cvbooster.append(bst)
+
+    cbs = list(callbacks or [])
+    best_iter = num_boost_round
+    best_scores: Dict[str, float] = {}
+    no_improve = 0
+    best_mean: Dict[str, float] = {}
+    for i in range(num_boost_round):
+        agg: Dict[str, List[float]] = {}
+        hib_map: Dict[str, bool] = {}
+        for bst in fold_boosters:
+            bst.update(fobj=fobj)
+            for name, metric, val, hib in bst._gbdt.eval_current():
+                if name == "training" and not eval_train_metric:
+                    continue
+                key = f"{name} {metric}"
+                agg.setdefault(key, []).append(val)
+                hib_map[key] = hib
+        stop_now = False
+        for key, vals in agg.items():
+            results.setdefault(f"{key}-mean", []).append(float(np.mean(vals)))
+            results.setdefault(f"{key}-stdv", []).append(float(np.std(vals)))
+        if early_stopping_rounds and agg:
+            key0 = next(iter(agg))
+            mean0 = float(np.mean(agg[key0]))
+            better = (mean0 > best_mean.get(key0, -np.inf)) if hib_map[key0] \
+                else (mean0 < best_mean.get(key0, np.inf))
+            if better:
+                best_mean[key0] = mean0
+                best_iter = i + 1
+                no_improve = 0
+            else:
+                no_improve += 1
+                if no_improve >= early_stopping_rounds:
+                    stop_now = True
+        if stop_now:
+            for key in list(results):
+                results[key] = results[key][:best_iter]
+            break
+    cvbooster.best_iteration = best_iter
+    if return_cvbooster:
+        results["cvbooster"] = cvbooster
+    return results

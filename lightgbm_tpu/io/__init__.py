@@ -1,0 +1,6 @@
+from .bin import BinMapper, BinType, MissingType
+from .dataset import Dataset, Metadata, DeviceData
+from .loader import load_file
+
+__all__ = ["BinMapper", "BinType", "MissingType", "Dataset", "Metadata",
+           "DeviceData", "load_file"]

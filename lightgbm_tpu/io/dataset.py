@@ -1,0 +1,323 @@
+"""Dataset: binned feature matrix + metadata, resident in HBM.
+
+TPU-native re-design of the reference ``Dataset`` / ``Metadata``
+(``include/LightGBM/dataset.h:282,41``, ``src/io/dataset.cpp``).  Semantics
+preserved: per-feature bin mappers, real<->inner feature maps with trivial
+features dropped, label/weight/query/init-score metadata, binary cache file,
+validation sets aligned to the training set's bin mappers.
+
+Mechanics replaced (by design, see SURVEY.md §7): no FeatureGroup / EFB /
+sparse bin classes / 4-bit packing — the binned data is ONE dense
+``[num_data, num_used_features]`` uint8/uint16 array (TPUs want dense batched
+layouts feeding the MXU), and histogram dispatch is a JAX op in
+``ops/histogram.py`` rather than virtual calls over bin containers.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import Log, check, LightGBMError
+from ..utils.random_gen import Random
+from .bin import BinMapper, BinType, MissingType
+
+
+class Metadata:
+    """Label / weight / query-boundary / init-score store (reference
+    ``dataset.h:41``, ``src/io/metadata.cpp``)."""
+
+    def __init__(self, num_data: int = 0) -> None:
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None  # [num_queries+1]
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_field(self, name: str, data) -> None:
+        if data is None:
+            setattr(self, {"label": "label", "weight": "weight", "group": "query_boundaries",
+                           "query": "query_boundaries", "init_score": "init_score"}[name], None)
+            return
+        arr = np.asarray(data)
+        if name == "label":
+            check(len(arr) == self.num_data, "label length mismatch")
+            self.label = arr.astype(np.float32).ravel()
+        elif name == "weight":
+            check(len(arr) == self.num_data, "weight length mismatch")
+            self.weight = arr.astype(np.float32).ravel()
+        elif name in ("group", "query"):
+            sizes = arr.astype(np.int64).ravel()
+            if sizes.sum() == self.num_data:      # group sizes
+                self.query_boundaries = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+            elif len(sizes) and sizes[0] == 0 and sizes[-1] == self.num_data:  # boundaries
+                self.query_boundaries = sizes
+            else:
+                raise LightGBMError("group sizes do not sum to num_data")
+        elif name == "init_score":
+            check(len(arr) % self.num_data == 0, "init_score length mismatch")
+            self.init_score = arr.astype(np.float64).ravel()
+        else:
+            raise LightGBMError(f"unknown field {name}")
+
+    def get_field(self, name: str):
+        return {"label": self.label, "weight": self.weight,
+                "group": self.query_boundaries, "query": self.query_boundaries,
+                "init_score": self.init_score}[name]
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+@dataclass
+class DeviceData:
+    """Device-resident tensors consumed by the tree learner."""
+    bins: Any            # [num_data, num_features] uint8/uint16 (jnp)
+    num_bins: Any        # [num_features] int32 — bins per feature
+    bin_offsets: Any     # [num_features+1] int32 — flattened histogram offsets
+    default_bins: Any    # [num_features] int32 — bin containing raw value 0
+    nan_bins: Any        # [num_features] int32 — NaN bin (== num_bin-1) or -1
+    is_categorical: Any  # [num_features] bool
+    monotone: Any        # [num_features] int8 (-1/0/+1)
+    total_bins: int
+
+
+class Dataset:
+    """Binned training/validation data (construction analog of
+    ``DatasetLoader::ConstructFromSampleData``, ``src/io/dataset_loader.cpp:618``)."""
+
+    def __init__(self, config: Optional[Config] = None) -> None:
+        self.config = config or Config()
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.bin_mappers: List[BinMapper] = []          # per real feature
+        self.used_features: List[int] = []              # inner -> real feature idx
+        self.real_to_inner: Dict[int, int] = {}
+        self.bins: Optional[np.ndarray] = None          # [num_data, num_used] u8/u16
+        self.metadata = Metadata()
+        self.feature_names: List[str] = []
+        self.reference: Optional["Dataset"] = None
+        self._device: Optional[DeviceData] = None
+
+    # ------------------------------------------------------------------
+    @property
+    def num_features(self) -> int:
+        return len(self.used_features)
+
+    def num_bin(self, inner_feature: int) -> int:
+        return self.bin_mappers[self.used_features[inner_feature]].num_bin
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_data(cls, data: np.ndarray, config: Optional[Config] = None,
+                  label=None, weight=None, group=None, init_score=None,
+                  categorical_feature: Optional[Sequence[int]] = None,
+                  feature_names: Optional[Sequence[str]] = None,
+                  reference: Optional["Dataset"] = None) -> "Dataset":
+        """Construct from a raw row-major matrix (the
+        ``LGBM_DatasetCreateFromMat`` path, ``src/c_api.cpp``)."""
+        config = config or Config()
+        self = cls(config)
+        data = _to_2d_float(data)
+        self.num_data, self.num_total_features = data.shape
+        self.feature_names = list(feature_names) if feature_names else [
+            f"Column_{i}" for i in range(self.num_total_features)]
+
+        if reference is not None:
+            # validation set: align bins with the training set
+            # (reference LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:260)
+            check(self.num_total_features == reference.num_total_features,
+                  "validation data has different number of features")
+            self.reference = reference
+            self.bin_mappers = reference.bin_mappers
+            self.used_features = reference.used_features
+            self.real_to_inner = reference.real_to_inner
+        else:
+            cats = set(_resolve_categorical(categorical_feature, self.feature_names, config))
+            self._construct_bin_mappers(data, cats)
+
+        self._bin_data(data)
+        md = Metadata(self.num_data)
+        self.metadata = md
+        if label is not None:
+            md.set_field("label", label)
+        if weight is not None:
+            md.set_field("weight", weight)
+        if group is not None:
+            md.set_field("group", group)
+        if init_score is not None:
+            md.set_field("init_score", init_score)
+        return self
+
+    # ------------------------------------------------------------------
+    def _construct_bin_mappers(self, data: np.ndarray, cats: set) -> None:
+        cfg = self.config
+        n = self.num_data
+        # row sampling for bin construction (reference bin_construct_sample_cnt,
+        # dataset_loader.cpp SampleTextDataFromFile:902)
+        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+        rng = Random(cfg.data_random_seed)
+        sample_idx = rng.sample(n, sample_cnt)
+        sample = data[sample_idx]
+
+        max_bin_by_feat = cfg.max_bin_by_feature
+        self.bin_mappers = []
+        for f in range(self.num_total_features):
+            fb = max_bin_by_feat[f] if f < len(max_bin_by_feat) else cfg.max_bin
+            bt = BinType.CATEGORICAL if f in cats else BinType.NUMERICAL
+            m = BinMapper.find_bin(
+                sample[:, f], sample_cnt, fb, cfg.min_data_in_bin,
+                cfg.min_data_in_leaf, cfg.feature_pre_filter, bin_type=bt,
+                use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing)
+            self.bin_mappers.append(m)
+        self.used_features = [f for f, m in enumerate(self.bin_mappers) if not m.is_trivial]
+        if not self.used_features:
+            Log.warning("There are no meaningful features, as all feature values are constant.")
+        self.real_to_inner = {f: i for i, f in enumerate(self.used_features)}
+
+    def _bin_data(self, data: np.ndarray) -> None:
+        n_used = len(self.used_features)
+        max_nb = max((self.bin_mappers[f].num_bin for f in self.used_features), default=1)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        bins = np.empty((self.num_data, n_used), dtype=dtype)
+        for i, f in enumerate(self.used_features):
+            bins[:, i] = self.bin_mappers[f].value_to_bin(data[:, f]).astype(dtype)
+        self.bins = bins
+
+    # ------------------------------------------------------------------
+    def device_data(self, monotone_constraints: Optional[Sequence[int]] = None) -> DeviceData:
+        """Materialize device tensors (lazily cached)."""
+        if self._device is not None and monotone_constraints is None:
+            return self._device
+        import jax.numpy as jnp
+        feats = self.used_features
+        nb = np.array([self.bin_mappers[f].num_bin for f in feats], dtype=np.int32)
+        offsets = np.concatenate([[0], np.cumsum(nb)]).astype(np.int32)
+        default_bins = np.array([self.bin_mappers[f].default_bin for f in feats], dtype=np.int32)
+        nan_bins = np.array(
+            [self.bin_mappers[f].num_bin - 1
+             if self.bin_mappers[f].missing_type == MissingType.NAN
+             and self.bin_mappers[f].bin_type == BinType.NUMERICAL else -1
+             for f in feats], dtype=np.int32)
+        is_cat = np.array([self.bin_mappers[f].bin_type == BinType.CATEGORICAL
+                           for f in feats], dtype=bool)
+        mono = np.zeros(len(feats), dtype=np.int8)
+        mc = monotone_constraints if monotone_constraints is not None else self.config.monotone_constraints
+        if mc:
+            for i, f in enumerate(feats):
+                if f < len(mc):
+                    mono[i] = mc[f]
+        dd = DeviceData(
+            bins=jnp.asarray(self.bins),
+            num_bins=jnp.asarray(nb),
+            bin_offsets=jnp.asarray(offsets),
+            default_bins=jnp.asarray(default_bins),
+            nan_bins=jnp.asarray(nan_bins),
+            is_categorical=jnp.asarray(is_cat),
+            monotone=jnp.asarray(mono),
+            total_bins=int(offsets[-1]),
+        )
+        if monotone_constraints is None:
+            self._device = dd
+        return dd
+
+    # ------------------------------------------------------------------
+    def save_binary(self, path: str) -> None:
+        """Binary cache (reference ``Dataset::SaveBinaryFile``)."""
+        import json
+        mappers = [m.to_state() for m in self.bin_mappers]
+        np.savez_compressed(
+            path if path.endswith(".npz") else path + ".npz",
+            bins=self.bins,
+            meta=json.dumps({
+                "num_data": self.num_data,
+                "num_total_features": self.num_total_features,
+                "used_features": self.used_features,
+                "feature_names": self.feature_names,
+                "mappers": mappers,
+            }),
+            label=self.metadata.label if self.metadata.label is not None else np.empty(0),
+            weight=self.metadata.weight if self.metadata.weight is not None else np.empty(0),
+            query=self.metadata.query_boundaries if self.metadata.query_boundaries is not None else np.empty(0, dtype=np.int64),
+            init_score=self.metadata.init_score if self.metadata.init_score is not None else np.empty(0),
+        )
+
+    @classmethod
+    def load_binary(cls, path: str, config: Optional[Config] = None) -> "Dataset":
+        import json
+        z = np.load(path if path.endswith(".npz") else path + ".npz", allow_pickle=False)
+        meta = json.loads(str(z["meta"]))
+        self = cls(config)
+        self.num_data = int(meta["num_data"])
+        self.num_total_features = int(meta["num_total_features"])
+        self.used_features = [int(f) for f in meta["used_features"]]
+        self.real_to_inner = {f: i for i, f in enumerate(self.used_features)}
+        self.feature_names = list(meta["feature_names"])
+        self.bin_mappers = [BinMapper.from_state(st) for st in meta["mappers"]]
+        self.bins = z["bins"]
+        self.metadata = Metadata(self.num_data)
+        if z["label"].size:
+            self.metadata.label = z["label"].astype(np.float32)
+        if z["weight"].size:
+            self.metadata.weight = z["weight"].astype(np.float32)
+        if z["query"].size:
+            self.metadata.query_boundaries = z["query"].astype(np.int64)
+        if z["init_score"].size:
+            self.metadata.init_score = z["init_score"].astype(np.float64)
+        return self
+
+    # ------------------------------------------------------------------
+    def subset(self, indices: np.ndarray) -> "Dataset":
+        """Row subset sharing bin mappers (reference ``Dataset::CopySubrow``,
+        used by bagging-with-subset and cv)."""
+        sub = Dataset(self.config)
+        sub.num_data = len(indices)
+        sub.num_total_features = self.num_total_features
+        sub.bin_mappers = self.bin_mappers
+        sub.used_features = self.used_features
+        sub.real_to_inner = self.real_to_inner
+        sub.feature_names = self.feature_names
+        sub.bins = self.bins[indices]
+        sub.reference = self
+        sub.metadata = Metadata(sub.num_data)
+        if self.metadata.label is not None:
+            sub.metadata.label = self.metadata.label[indices]
+        if self.metadata.weight is not None:
+            sub.metadata.weight = self.metadata.weight[indices]
+        if self.metadata.init_score is not None:
+            ns = len(self.metadata.init_score) // self.num_data
+            sub.metadata.init_score = self.metadata.init_score.reshape(
+                ns, self.num_data)[:, indices].ravel()
+        return sub
+
+
+def _to_2d_float(data) -> np.ndarray:
+    if hasattr(data, "values"):   # pandas
+        data = data.values
+    arr = np.asarray(data)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    check(arr.ndim == 2, "data must be 2-dimensional")
+    return np.ascontiguousarray(arr, dtype=np.float64)
+
+
+def _resolve_categorical(categorical_feature, feature_names: List[str], config: Config) -> List[int]:
+    spec = categorical_feature if categorical_feature is not None else config.categorical_feature
+    if spec is None or spec == "" or spec == "auto":
+        return []
+    out: List[int] = []
+    items = spec if isinstance(spec, (list, tuple)) else [s for s in str(spec).split(",") if s]
+    for it in items:
+        if isinstance(it, str) and not it.lstrip("-").isdigit():
+            if it.startswith("name:"):
+                it = it[5:]
+            if it in feature_names:
+                out.append(feature_names.index(it))
+            else:
+                Log.warning("categorical feature %s not found in feature names", it)
+        else:
+            out.append(int(it))
+    return sorted(set(out))

@@ -1,0 +1,248 @@
+"""Metric interface + regression/binary/multiclass metrics.
+
+Analog of the reference ``Metric`` (``include/LightGBM/metric.h``;
+implementations ``src/metric/{regression,binary,multiclass}_metric.hpp``).
+``eval(score, objective)`` receives RAW scores and uses the objective's
+output transform, exactly like the reference.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+
+
+class Metric:
+    name: str = "base"
+    higher_better: bool = False
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = metadata.label
+        self.weight = metadata.weight
+        self.query_boundaries = metadata.query_boundaries
+        self.sum_weights = (float(np.sum(self.weight))
+                            if self.weight is not None else float(num_data))
+
+    def eval(self, score: np.ndarray, objective=None) -> List[Tuple[str, float, bool]]:
+        raise NotImplementedError
+
+    # -- helpers --------------------------------------------------------
+    def _transform(self, score: np.ndarray, objective) -> np.ndarray:
+        if objective is not None:
+            out = objective.convert_output(score)
+            return np.asarray(out)
+        return score
+
+    def _avg(self, pointwise: np.ndarray) -> float:
+        if self.weight is not None:
+            return float(np.sum(pointwise * self.weight) / self.sum_weights)
+        return float(np.mean(pointwise))
+
+
+class _PointwiseRegressionMetric(Metric):
+    def point_loss(self, y: np.ndarray, p: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def eval(self, score, objective=None):
+        pred = self._transform(score, objective)
+        return [(self.name, self._avg(self.point_loss(self.label, pred)), self.higher_better)]
+
+
+class L2Metric(_PointwiseRegressionMetric):
+    name = "l2"
+
+    def point_loss(self, y, p):
+        return (y - p) ** 2
+
+
+class RMSEMetric(_PointwiseRegressionMetric):
+    name = "rmse"
+
+    def eval(self, score, objective=None):
+        pred = self._transform(score, objective)
+        return [(self.name, float(np.sqrt(self._avg((self.label - pred) ** 2))), False)]
+
+
+class L1Metric(_PointwiseRegressionMetric):
+    name = "l1"
+
+    def point_loss(self, y, p):
+        return np.abs(y - p)
+
+
+class QuantileMetric(_PointwiseRegressionMetric):
+    name = "quantile"
+
+    def point_loss(self, y, p):
+        a = self.config.alpha
+        d = y - p
+        return np.where(d >= 0, a * d, (a - 1) * d)
+
+
+class HuberMetric(_PointwiseRegressionMetric):
+    name = "huber"
+
+    def point_loss(self, y, p):
+        a = self.config.alpha
+        d = np.abs(y - p)
+        return np.where(d <= a, 0.5 * d * d, a * (d - 0.5 * a))
+
+
+class FairMetric(_PointwiseRegressionMetric):
+    name = "fair"
+
+    def point_loss(self, y, p):
+        c = self.config.fair_c
+        x = np.abs(y - p)
+        return c * x - c * c * np.log1p(x / c)
+
+
+class PoissonMetric(_PointwiseRegressionMetric):
+    name = "poisson"
+
+    def point_loss(self, y, p):
+        eps = 1e-10
+        return p - y * np.log(np.maximum(p, eps))
+
+
+class MAPEMetric(_PointwiseRegressionMetric):
+    name = "mape"
+
+    def point_loss(self, y, p):
+        return np.abs((y - p) / np.maximum(1.0, np.abs(y)))
+
+
+class GammaMetric(_PointwiseRegressionMetric):
+    name = "gamma"
+
+    def point_loss(self, y, p):
+        psi = 1.0
+        theta = -1.0 / np.maximum(p, 1e-10)
+        a = psi
+        b = -np.log(-theta)
+        c = 1.0 / psi * np.log(y / psi) - np.log(y) - 0  # lgamma(1/psi) const dropped
+        from scipy.special import gammaln  # scipy is available with sklearn
+        c = 1.0 / psi * np.log(y / psi) - np.log(y) - gammaln(1.0 / psi)
+        return -((y * theta + b) / a + c)
+
+
+class GammaDevianceMetric(_PointwiseRegressionMetric):
+    name = "gamma_deviance"
+
+    def point_loss(self, y, p):
+        eps = 1e-10
+        frac = y / np.maximum(p, eps)
+        return 2.0 * (frac - np.log(np.maximum(frac, eps)) - 1.0)
+
+
+class TweedieMetric(_PointwiseRegressionMetric):
+    name = "tweedie"
+
+    def point_loss(self, y, p):
+        rho = self.config.tweedie_variance_power
+        eps = 1e-10
+        p = np.maximum(p, eps)
+        a = y * np.exp((1.0 - rho) * np.log(p)) / (1.0 - rho)
+        b = np.exp((2.0 - rho) * np.log(p)) / (2.0 - rho)
+        return -a + b
+
+
+class BinaryLoglossMetric(Metric):
+    name = "binary_logloss"
+
+    def eval(self, score, objective=None):
+        prob = np.clip(self._transform(score, objective), 1e-15, 1 - 1e-15)
+        y = (self.label > 0).astype(np.float64)
+        loss = -(y * np.log(prob) + (1 - y) * np.log(1 - prob))
+        return [(self.name, self._avg(loss), False)]
+
+
+class BinaryErrorMetric(Metric):
+    name = "binary_error"
+
+    def eval(self, score, objective=None):
+        prob = self._transform(score, objective)
+        y = (self.label > 0).astype(np.float64)
+        err = ((prob > 0.5) != (y > 0)).astype(np.float64)
+        return [(self.name, self._avg(err), False)]
+
+
+class AUCMetric(Metric):
+    name = "auc"
+    higher_better = True
+
+    def eval(self, score, objective=None):
+        # weighted rank-sum AUC with tie handling (reference
+        # binary_metric.hpp AUCMetric::Eval), vectorized over tie groups
+        score = np.asarray(score, dtype=np.float64).ravel()
+        y = (self.label > 0)
+        w = (self.weight if self.weight is not None
+             else np.ones(len(y))).astype(np.float64)
+        order = np.argsort(score, kind="mergesort")
+        s, ys, ws = score[order], y[order], w[order]
+        pos_w = ws[ys].sum()
+        neg_w = ws[~ys].sum()
+        if pos_w <= 0 or neg_w <= 0:
+            return [(self.name, 0.5, True)]
+        # group boundaries of tied scores
+        new_grp = np.empty(len(s), bool)
+        new_grp[0] = True
+        new_grp[1:] = s[1:] != s[:-1]
+        gid = np.cumsum(new_grp) - 1
+        n_grp = gid[-1] + 1
+        wp = np.bincount(gid, weights=ws * ys, minlength=n_grp)       # pos mass/group
+        wn = np.bincount(gid, weights=ws * ~ys, minlength=n_grp)      # neg mass/group
+        neg_below = np.concatenate([[0.0], np.cumsum(wn)[:-1]])
+        auc = np.sum(wp * (neg_below + wn / 2.0)) / (pos_w * neg_w)
+        return [(self.name, float(auc), True)]
+
+
+class AveragePrecisionMetric(Metric):
+    name = "average_precision"
+    higher_better = True
+
+    def eval(self, score, objective=None):
+        y = (self.label > 0).astype(np.float64)
+        w = self.weight if self.weight is not None else np.ones(len(y))
+        order = np.argsort(-np.asarray(score), kind="mergesort")
+        ys, ws = y[order], w[order]
+        tp = np.cumsum(ws * ys)
+        fp = np.cumsum(ws * (1 - ys))
+        precision = tp / np.maximum(tp + fp, 1e-20)
+        total_pos = tp[-1]
+        if total_pos <= 0:
+            return [(self.name, 0.0, True)]
+        ap = np.sum(precision * ws * ys) / total_pos
+        return [(self.name, float(ap), True)]
+
+
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def eval(self, score, objective=None):
+        # score: [K, N]
+        prob = np.clip(self._transform(score, objective), 1e-15, 1.0)
+        lbl = self.label.astype(np.int64)
+        p_true = prob[lbl, np.arange(len(lbl))]
+        return [(self.name, self._avg(-np.log(p_true)), False)]
+
+
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+
+    def eval(self, score, objective=None):
+        prob = self._transform(score, objective)     # [K, N]
+        lbl = self.label.astype(np.int64)
+        k = self.config.multi_error_top_k
+        if k <= 1:
+            err = (np.argmax(prob, axis=0) != lbl).astype(np.float64)
+        else:
+            topk = np.argsort(-prob, axis=0)[:k]
+            err = (~(topk == lbl[None, :]).any(axis=0)).astype(np.float64)
+        return [(self.name if k <= 1 else f"multi_error@{k}", self._avg(err), False)]

@@ -1,0 +1,7 @@
+from .tree import Tree
+from .gbdt import GBDT
+from .dart import DART
+from .goss import GOSS
+from .rf import RF
+
+__all__ = ["Tree", "GBDT", "DART", "GOSS", "RF"]

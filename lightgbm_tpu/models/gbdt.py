@@ -1,0 +1,375 @@
+"""GBDT: the boosting engine.
+
+TPU-native re-design of the reference ``GBDT`` (``src/boosting/gbdt.cpp``):
+same training-loop semantics — boost-from-average (``gbdt.cpp:344``),
+per-iteration gradients (``:170``), bagging (``:228``), one tree per class per
+iteration, shrinkage, score-cache updates (``:491``), early stopping
+(``:517-575``), model text IO (``gbdt_model_text.cpp``) — but each boosting
+iteration's compute (gradients → bagging mask → tree growth → score update)
+runs as compiled JAX programs with device-resident scores, and the tree
+learner is the single-program grower in ``ops/grower.py``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..io.dataset import Dataset, DeviceData
+from ..metric import create_metrics
+from ..objective import ObjectiveFunction, create_objective
+from ..ops.grower import GrowerConfig, TreeArrays, grow_tree
+from ..ops.predict import predict_leaf_binned
+from ..ops.split import SplitParams
+from ..utils.log import Log, check, LightGBMError
+from ..utils.random_gen import key_for_iteration
+from ..utils.timer import global_timer
+from .tree import Tree
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree engine (reference ``gbdt.h:35``)."""
+
+    def __init__(self, config: Config, train_data: Optional[Dataset] = None,
+                 objective: Optional[ObjectiveFunction] = None):
+        self.config = config
+        self.train_data: Optional[Dataset] = None
+        self.objective = objective
+        self.models: List[Tree] = []
+        self.valid_sets: List[Dataset] = []
+        self.valid_names: List[str] = []
+        self.iter_ = 0
+        self.num_class = config.num_class
+        self.num_tree_per_iteration = 1
+        self.max_feature_idx = 0
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self.init_scores: List[float] = []
+        self.shrinkage_rate = config.learning_rate
+        self._train_score = None       # [K, N] device
+        self._valid_scores: List = []
+        self._eval_history: Dict[str, Dict[str, List[float]]] = {}
+        self._early_stop_counter = 0
+        self._best_iter: Dict[str, int] = {}
+        self._prev_scores = None
+        self._device_trees: List = []        # per-model device TreeArrays
+        self._tree_weights: List[float] = []  # current scale of each model
+        if train_data is not None:
+            self.init_train(train_data)
+
+    # ------------------------------------------------------------------
+    def init_train(self, train_data: Dataset) -> None:
+        cfg = self.config
+        self.train_data = train_data
+        if self.objective is None:
+            self.objective = create_objective(cfg)
+        if self.objective is not None:
+            self.objective.init(train_data.metadata, train_data.num_data)
+            self.num_tree_per_iteration = self.objective.num_model_per_iteration
+        else:
+            self.num_tree_per_iteration = max(1, cfg.num_class)
+        self.max_feature_idx = train_data.num_total_features - 1
+        self.train_metrics = create_metrics(cfg)
+        for m in self.train_metrics:
+            m.init(train_data.metadata, train_data.num_data)
+        self._dd = train_data.device_data()
+        self._label_dev = (jnp.asarray(train_data.metadata.label)
+                          if train_data.metadata.label is not None else None)
+        self._weight_dev = (jnp.asarray(train_data.metadata.weight)
+                           if train_data.metadata.weight is not None else None)
+        K = self.num_tree_per_iteration
+        n = train_data.num_data
+
+        # boost from average / init_score (gbdt.cpp:338-368)
+        init = np.zeros((K, n), dtype=np.float32)
+        md_init = train_data.metadata.init_score
+        self.init_scores = [0.0] * K
+        if md_init is not None:
+            init += md_init.reshape(-1, n).astype(np.float32)
+        elif cfg.boost_from_average and self.objective is not None:
+            for k in range(K):
+                s = self.objective.boost_from_score(k)
+                self.init_scores[k] = s
+                init[k] += s
+        self._train_score = jnp.asarray(init)
+        self._grower_cfg = self._make_grower_cfg()
+
+    def _make_grower_cfg(self) -> GrowerConfig:
+        cfg = self.config
+        max_bin = int(max((self.train_data.num_bin(i)
+                           for i in range(self.train_data.num_features)), default=2))
+        # round up to a TPU-friendly lane width
+        max_bin = max(4, min(cfg.max_bin + 1, -(-max_bin // 4) * 4))
+        sp = SplitParams(
+            lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+            min_gain_to_split=cfg.min_gain_to_split,
+            max_delta_step=cfg.max_delta_step,
+            path_smooth=cfg.path_smooth,
+            cat_smooth=cfg.cat_smooth, cat_l2=cfg.cat_l2,
+            max_cat_to_onehot=cfg.max_cat_to_onehot)
+        return GrowerConfig(
+            num_leaves=cfg.num_leaves, max_depth=cfg.max_depth, max_bin=max_bin,
+            split=sp, feature_fraction_bynode=cfg.feature_fraction_bynode,
+            hist_method=("scatter" if jax.default_backend() == "cpu" else "onehot"),
+            hist_chunk_rows=cfg.hist_chunk_rows)
+
+    def add_valid_data(self, valid_data: Dataset, name: str) -> None:
+        check(valid_data.reference is self.train_data or
+              valid_data.bin_mappers is self.train_data.bin_mappers,
+              "validation set must be constructed with reference=train_set")
+        self.valid_sets.append(valid_data)
+        self.valid_names.append(name)
+        metrics = create_metrics(self.config)
+        for m in metrics:
+            m.init(valid_data.metadata, valid_data.num_data)
+        if not hasattr(self, "valid_metrics"):
+            self.valid_metrics = []
+        self.valid_metrics.append(metrics)
+        K = self.num_tree_per_iteration
+        n = valid_data.num_data
+        init = np.zeros((K, n), dtype=np.float32)
+        md_init = valid_data.metadata.init_score
+        if md_init is not None:
+            init += md_init.reshape(-1, n).astype(np.float32)
+        else:
+            for k in range(K):
+                init[k] += self.init_scores[k]
+        self._valid_scores.append(jnp.asarray(init))
+
+    # ------------------------------------------------------------------
+    # bagging (gbdt.cpp:182-262); subclasses (GOSS) override
+    def _bagging_weights(self, iteration: int, grad, hess):
+        cfg = self.config
+        n = self.train_data.num_data
+        need = cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0 or
+                                         cfg.pos_bagging_fraction < 1.0 or
+                                         cfg.neg_bagging_fraction < 1.0)
+        if not need:
+            return None, grad, hess
+        if iteration % cfg.bagging_freq == 0:
+            key = key_for_iteration(cfg.bagging_seed, iteration // cfg.bagging_freq)
+            u = jax.random.uniform(key, (n,))
+            if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
+                is_pos = self._label_dev > 0
+                frac = jnp.where(is_pos, cfg.pos_bagging_fraction, cfg.neg_bagging_fraction)
+            else:
+                frac = cfg.bagging_fraction
+            self._bag_mask = (u < frac).astype(jnp.float32)
+        mask = self._bag_mask
+        return mask, grad * mask, hess * mask
+
+    def _feature_mask(self, iteration: int) -> jnp.ndarray:
+        cfg = self.config
+        f = self.train_data.num_features
+        if cfg.feature_fraction >= 1.0:
+            return jnp.ones(f, jnp.float32)
+        # per-tree column sampling (ColSampler::ResetByTree, col_sampler.hpp:74)
+        rng = np.random.default_rng(cfg.feature_fraction_seed + iteration)
+        k = max(1, int(round(cfg.feature_fraction * f)))
+        mask = np.zeros(f, np.float32)
+        mask[rng.choice(f, size=k, replace=False)] = 1.0
+        return jnp.asarray(mask)
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (reference ``GBDT::TrainOneIter``,
+        ``gbdt.cpp:369``).  Returns True if training should stop (no splits)."""
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        n = self.train_data.num_data
+        it = self.iter_
+
+        with global_timer.scope("GBDT::gradients"):
+            if grad is None or hess is None:
+                g, h = self._compute_gradients(self._train_score)
+            else:
+                g = jnp.asarray(np.asarray(grad, np.float32).reshape(K, n))
+                h = jnp.asarray(np.asarray(hess, np.float32).reshape(K, n))
+
+        bag_mask, g, h = self._bagging_weights(it, g, h)
+        row_weight = bag_mask if bag_mask is not None else jnp.ones(n, jnp.float32)
+        fmask = self._feature_mask(it)
+        self._prev_scores = (self._train_score, list(self._valid_scores))
+
+        should_stop = True
+        for k in range(K):
+            with global_timer.scope("GBDT::grow_tree"):
+                tree_arrays, node_assign = self._grow_jit(
+                    self._dd.bins, g[k], h[k], row_weight, fmask,
+                    key_for_iteration(cfg.seed, it, salt=k + 1))
+            nl = int(tree_arrays.num_leaves)
+            if nl > 1:
+                should_stop = False
+            tree = Tree.from_arrays(tree_arrays, self.train_data, learning_rate=1.0)
+
+            # leaf renewal for L1-style objectives (RenewTreeOutput,
+            # serial_tree_learner.cpp:684)
+            if self.objective is not None and self.objective.need_renew_tree_output() and nl > 1:
+                leaf_pred = np.asarray(node_assign)
+                score_host = np.asarray(self._train_score[k], np.float64)
+                new_vals = self.objective.renew_leaf_values(
+                    leaf_pred, score_host, tree.leaf_value.copy(), nl)
+                tree.leaf_value = np.asarray(new_vals, np.float64)
+                tree_arrays = tree_arrays._replace(
+                    leaf_value=jnp.asarray(tree.leaf_value, jnp.float32))
+
+            tree.shrink(self.shrinkage_rate)
+            # first tree carries the boost-from-average bias (Tree::AddBias);
+            # a split-less first tree becomes a constant tree holding the bias
+            if it == 0 and self.init_scores[k] != 0.0:
+                if nl > 1:
+                    tree.add_bias(self.init_scores[k])
+                else:
+                    tree.leaf_value = np.full_like(tree.leaf_value, self.init_scores[k])
+
+            with global_timer.scope("GBDT::update_score"):
+                delta = tree_arrays.leaf_value * self.shrinkage_rate
+                self._train_score = self._train_score.at[k].add(
+                    jnp.where(nl > 1, delta[node_assign], 0.0))
+                for vi, vset in enumerate(self.valid_sets):
+                    vleaf = self._predict_leaf_jit(tree_arrays, vset.device_data().bins)
+                    self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
+                        jnp.where(nl > 1, delta[vleaf], 0.0))
+            self.models.append(tree)
+            self._device_trees.append(tree_arrays)
+            self._tree_weights.append(self.shrinkage_rate)
+
+        self.iter_ += 1
+        if should_stop:
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+        return should_stop
+
+    def _compute_gradients(self, score):
+        obj = self.objective
+        if obj is None:
+            raise LightGBMError("objective is None; provide custom grad/hess")
+        if self.num_tree_per_iteration > 1:
+            return obj.get_gradients_multi(score, self._label_dev, self._weight_dev)
+        g, h = obj.get_gradients(score[0], self._label_dev, self._weight_dev)
+        return g[None, :], h[None, :]
+
+    @functools.cached_property
+    def _grow_jit(self):
+        dd = self._dd
+        cfg = self._grower_cfg
+
+        @jax.jit
+        def fn(bins, g, h, rw, fmask, key):
+            return grow_tree(bins, g, h, rw, fmask, dd.num_bins, dd.default_bins,
+                             dd.nan_bins, dd.is_categorical, dd.monotone, key, cfg)
+        return fn
+
+    @functools.cached_property
+    def _predict_leaf_jit(self):
+        dd = self._dd
+
+        @jax.jit
+        def fn(tree_arrays, bins):
+            return predict_leaf_binned(tree_arrays, bins, dd.nan_bins)
+        return fn
+
+    # ------------------------------------------------------------------
+    def eval_current(self) -> List[Tuple[str, str, float, bool]]:
+        """Evaluate all metrics on train (if enabled) + valid sets.
+        Returns (dataset_name, metric_name, value, higher_better)."""
+        out = []
+        if self.config.is_provide_training_metric and self.train_metrics:
+            score = np.asarray(self._train_score, np.float64)
+            s = score[0] if self.num_tree_per_iteration == 1 else score
+            for m in self.train_metrics:
+                for name, val, hib in m.eval(s, self.objective):
+                    out.append(("training", name, val, hib))
+        for vi, vset in enumerate(self.valid_sets):
+            score = np.asarray(self._valid_scores[vi], np.float64)
+            s = score[0] if self.num_tree_per_iteration == 1 else score
+            for m in self.valid_metrics[vi]:
+                for name, val, hib in m.eval(s, self.objective):
+                    out.append((self.valid_names[vi], name, val, hib))
+        return out
+
+    # ------------------------------------------------------------------
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
+                    start_iteration: int = 0) -> np.ndarray:
+        """Raw scores [N] or [N, K] (reference ``GBDT::PredictRaw``)."""
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        K = self.num_tree_per_iteration
+        n_iters = len(self.models) // K
+        if num_iteration is not None and num_iteration > 0:
+            n_iters = min(n_iters, num_iteration)
+        out = np.zeros((X.shape[0], K))
+        for i in range(start_iteration, start_iteration + n_iters):
+            for k in range(K):
+                ti = i * K + k
+                if ti < len(self.models):
+                    out[:, k] += self.models[ti].predict(X)
+        return out[:, 0] if K == 1 else out
+
+    def predict(self, X: np.ndarray, num_iteration: int = -1,
+                start_iteration: int = 0, raw_score: bool = False) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration, start_iteration)
+        if raw_score or self.objective is None:
+            return raw
+        if self.num_tree_per_iteration > 1:
+            return np.asarray(self.objective.convert_output(raw.T)).T
+        return np.asarray(self.objective.convert_output(raw))
+
+    def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        K = self.num_tree_per_iteration
+        n_iters = len(self.models) // K
+        if num_iteration is not None and num_iteration > 0:
+            n_iters = min(n_iters, num_iteration)
+        out = np.zeros((X.shape[0], n_iters * K), np.int32)
+        for i in range(n_iters * K):
+            out[:, i] = self.models[i].predict_leaf_index(X)
+        return out
+
+    # ------------------------------------------------------------------
+    def rollback_one_iter(self) -> None:
+        """Reference ``GBDT::RollbackOneIter`` (``gbdt.cpp:454``): undo the
+        last iteration's trees and restore cached scores (one-step history)."""
+        if self.iter_ <= 0:
+            return
+        if self._prev_scores is None:
+            raise LightGBMError("rollback history exhausted (only one step kept)")
+        K = self.num_tree_per_iteration
+        self.models = self.models[:-K]
+        self._device_trees = self._device_trees[:-K]
+        self._tree_weights = self._tree_weights[:-K]
+        self.iter_ -= 1
+        self._train_score, self._valid_scores = self._prev_scores
+        self._prev_scores = None
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = -1) -> np.ndarray:
+        """split/gain importance (reference ``GBDT::FeatureImportance``,
+        ``gbdt.cpp:606``)."""
+        n_feat = self.max_feature_idx + 1
+        imp = np.zeros(n_feat)
+        models = self.models
+        if iteration is not None and iteration > 0:
+            models = models[:iteration * self.num_tree_per_iteration]
+        for tree in models:
+            for j in range(tree.num_internal):
+                if tree.num_leaves > 1 and tree.split_gain[j] > 0:
+                    f = tree.split_feature[j]
+                    if importance_type == "split":
+                        imp[f] += 1
+                    else:
+                        imp[f] += tree.split_gain[j]
+        return imp

@@ -1,0 +1,34 @@
+"""GOSS: Gradient-based One-Side Sampling (reference ``src/boosting/goss.hpp``).
+
+Keeps the top ``top_rate`` fraction of rows by |g·h| and a random
+``other_rate`` fraction of the rest, scaling the sampled rows' gradients and
+hessians by ``(1-top_rate)/other_rate`` (``goss.hpp:103-152``) — expressed as
+device-side ``top_k`` + masked scaling instead of a partial sort.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.random_gen import key_for_iteration
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    def _bagging_weights(self, iteration, grad, hess):
+        cfg = self.config
+        n = self.train_data.num_data
+        top_rate, other_rate = cfg.top_rate, cfg.other_rate
+        if top_rate + other_rate >= 1.0:
+            return None, grad, hess
+        # importance = sum over classes of |g*h| (goss.hpp:115)
+        imp = jnp.sum(jnp.abs(grad * hess), axis=0)
+        top_k = max(1, int(top_rate * n))
+        thresh = jax.lax.top_k(imp, top_k)[0][-1]
+        is_top = imp >= thresh
+        key = key_for_iteration(cfg.bagging_seed, iteration)
+        sampled = (jax.random.uniform(key, (n,)) < other_rate) & ~is_top
+        mask = (is_top | sampled).astype(jnp.float32)
+        scale = (1.0 - top_rate) / max(other_rate, 1e-12)
+        amplify = jnp.where(sampled, scale, 1.0)[None, :]
+        return mask, grad * amplify, hess * amplify

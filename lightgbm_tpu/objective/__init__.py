@@ -1,0 +1,49 @@
+"""Objective factory (reference ``src/objective/objective_function.cpp:16-48``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import Config
+from ..utils.log import Log
+from .base import ObjectiveFunction
+from .binary import BinaryLogloss
+from .multiclass import MulticlassSoftmax, MulticlassOVA
+from .regression import (RegressionL2Loss, RegressionL1Loss, HuberLoss,
+                         FairLoss, PoissonLoss, QuantileLoss, MAPELoss,
+                         GammaLoss, TweedieLoss)
+
+_REGISTRY = {
+    "regression": RegressionL2Loss,
+    "regression_l1": RegressionL1Loss,
+    "huber": HuberLoss,
+    "fair": FairLoss,
+    "poisson": PoissonLoss,
+    "quantile": QuantileLoss,
+    "mape": MAPELoss,
+    "gamma": GammaLoss,
+    "tweedie": TweedieLoss,
+    "binary": BinaryLogloss,
+    "multiclass": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+}
+
+
+def create_objective(config: Config) -> Optional[ObjectiveFunction]:
+    name = config.objective
+    if name == "none":
+        return None
+    # ranking / xentropy objectives register themselves on import
+    if name in ("lambdarank", "rank_xendcg"):
+        from . import rank  # noqa: F401
+    if name in ("cross_entropy", "cross_entropy_lambda"):
+        from . import xentropy  # noqa: F401
+    if name not in _REGISTRY:
+        Log.fatal("Unknown objective type name: %s", name)
+    return _REGISTRY[name](config)
+
+
+def register_objective(name: str, cls) -> None:
+    _REGISTRY[name] = cls
+
+
+__all__ = ["ObjectiveFunction", "create_objective", "register_objective"]

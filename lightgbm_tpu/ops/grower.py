@@ -1,0 +1,318 @@
+"""Leaf-wise (best-first) tree growth as ONE compiled XLA program.
+
+TPU-native re-design of the reference's ``SerialTreeLearner::Train``
+(``src/treelearner/serial_tree_learner.cpp:158-209``).  Semantics preserved:
+
+- best-first growth: each step splits the active leaf with the max split gain
+  (``serial_tree_learner.cpp:194-201``);
+- the smaller child's histogram is computed, the larger sibling's obtained by
+  subtraction (the histogram-subtraction trick, ``:306-320``);
+- the left child keeps the parent's leaf id, the right child gets the next
+  fresh id (the reference ``Tree::Split`` leaf-numbering convention);
+- depth / min-data / min-hessian / min-gain gates;
+- monotone-constraint (basic mode) output-bound propagation
+  (``monotone_constraints.hpp`` BasicConstraint).
+
+Mechanics replaced: no per-leaf index partition (``data_partition.hpp``) — a
+dense ``node_assignment[num_data]`` vector and masked histogram passes keep
+every shape static so the whole ``num_leaves-1`` split loop is a single
+``lax.fori_loop`` compiled once; no histogram LRU pool — a dense
+``[num_leaves, F, B, 3]`` store (HBM is the pool).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .histogram import build_histogram
+from .split import (NEG_INF, SplitParams, SplitResult, find_best_split,
+                    leaf_output)
+
+
+class GrowerConfig(NamedTuple):
+    """Static (compile-time) grower parameters."""
+    num_leaves: int
+    max_depth: int            # <=0: unlimited
+    max_bin: int              # histogram width B
+    split: SplitParams
+    feature_fraction_bynode: float
+    hist_method: str          # 'onehot' | 'scatter'
+    hist_chunk_rows: int
+
+
+class TreeArrays(NamedTuple):
+    """Flat-array tree (device layout of the reference ``Tree``, ``tree.h:25``).
+
+    Internal node ``j`` is created at split step ``j``; child pointers encode
+    leaves as ``~leaf_id`` (the reference's negative-leaf convention).
+    """
+    split_feature: jax.Array   # [L-1] i32, -1 = unused node
+    threshold: jax.Array       # [L-1] i32 bin threshold
+    default_left: jax.Array    # [L-1] bool
+    is_cat_split: jax.Array    # [L-1] bool
+    split_gain: jax.Array      # [L-1] f32
+    left_child: jax.Array      # [L-1] i32
+    right_child: jax.Array     # [L-1] i32
+    leaf_value: jax.Array      # [L] f32
+    leaf_count: jax.Array      # [L] f32 (weighted)
+    leaf_weight: jax.Array     # [L] f32 (sum of hessians)
+    internal_value: jax.Array  # [L-1] f32 (node output, for model IO / SHAP)
+    internal_count: jax.Array  # [L-1] f32
+    num_leaves: jax.Array      # scalar i32 (actual leaves grown)
+
+
+class _BestSplits(NamedTuple):
+    """Per-leaf pending best split (SoA of SplitResult over leaves)."""
+    gain: jax.Array; feature: jax.Array; threshold: jax.Array
+    default_left: jax.Array
+    lg: jax.Array; lh: jax.Array; lc: jax.Array
+    rg: jax.Array; rh: jax.Array; rc: jax.Array
+    lout: jax.Array; rout: jax.Array
+
+    @classmethod
+    def empty(cls, n: int) -> "_BestSplits":
+        z = jnp.zeros(n, jnp.float32)
+        return cls(gain=jnp.full(n, NEG_INF, jnp.float32),
+                   feature=jnp.zeros(n, jnp.int32), threshold=jnp.zeros(n, jnp.int32),
+                   default_left=jnp.zeros(n, bool),
+                   lg=z, lh=z, lc=z, rg=z, rh=z, rc=z, lout=z, rout=z)
+
+    def set_leaf(self, i, s: SplitResult) -> "_BestSplits":
+        return _BestSplits(
+            gain=self.gain.at[i].set(s.gain),
+            feature=self.feature.at[i].set(s.feature),
+            threshold=self.threshold.at[i].set(s.threshold),
+            default_left=self.default_left.at[i].set(s.default_left),
+            lg=self.lg.at[i].set(s.left_sum_g), lh=self.lh.at[i].set(s.left_sum_h),
+            lc=self.lc.at[i].set(s.left_count),
+            rg=self.rg.at[i].set(s.right_sum_g), rh=self.rh.at[i].set(s.right_sum_h),
+            rc=self.rc.at[i].set(s.right_count),
+            lout=self.lout.at[i].set(s.left_output),
+            rout=self.rout.at[i].set(s.right_output))
+
+
+def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+              row_weight: jax.Array, feature_mask: jax.Array,
+              num_bins: jax.Array, default_bins: jax.Array, nan_bins: jax.Array,
+              is_categorical: jax.Array, monotone: jax.Array,
+              key: jax.Array, cfg: GrowerConfig
+              ) -> Tuple[TreeArrays, jax.Array]:
+    """Grow one tree.  Returns (tree, node_assignment[num_data])."""
+    n, f = bins.shape
+    L = cfg.num_leaves
+    B = cfg.max_bin
+    p = cfg.split
+
+    def hist_of(mask):
+        return build_histogram(bins, grad, hess, mask, B,
+                               method=cfg.hist_method,
+                               chunk_rows=cfg.hist_chunk_rows)
+
+    def node_feature_mask(step):
+        if cfg.feature_fraction_bynode >= 1.0:
+            return feature_mask
+        k = jax.random.fold_in(key, step)
+        frac = cfg.feature_fraction_bynode
+        n_take = max(1, int(frac * f + 0.5))
+        u = jax.random.uniform(k, (f,))
+        u = jnp.where(feature_mask > 0, u, -jnp.inf)
+        thresh = jax.lax.top_k(u, n_take)[0][-1]
+        return jnp.where(u >= thresh, feature_mask, 0.0)
+
+    # ---- degenerate case: no usable features -> single-leaf tree -----------
+    if f == 0:
+        empty = TreeArrays(
+            split_feature=jnp.full(L - 1, -1, jnp.int32),
+            threshold=jnp.zeros(L - 1, jnp.int32),
+            default_left=jnp.zeros(L - 1, bool),
+            is_cat_split=jnp.zeros(L - 1, bool),
+            split_gain=jnp.zeros(L - 1, jnp.float32),
+            left_child=jnp.full(L - 1, -1, jnp.int32),
+            right_child=jnp.full(L - 1, -1, jnp.int32),
+            leaf_value=jnp.zeros(L, jnp.float32),
+            leaf_count=jnp.zeros(L, jnp.float32).at[0].set(jnp.sum(row_weight)),
+            leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(jnp.sum(hess * row_weight)),
+            internal_value=jnp.zeros(L - 1, jnp.float32),
+            internal_count=jnp.zeros(L - 1, jnp.float32),
+            num_leaves=jnp.int32(1))
+        return empty, jnp.zeros(n, jnp.int32)
+
+    # ---- root --------------------------------------------------------------
+    root_hist = hist_of(row_weight)
+    tot = jnp.stack([jnp.sum(grad * row_weight), jnp.sum(hess * row_weight),
+                     jnp.sum(row_weight)])
+    root_split = find_best_split(
+        root_hist, num_bins, default_bins, nan_bins, is_categorical, monotone,
+        tot[0], tot[1], tot[2], p, node_feature_mask(0))
+
+    hist_store = jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist)
+    best = _BestSplits.empty(L).set_leaf(0, root_split)
+    # depth gate for root handled trivially (max_depth >= 1 always allows root)
+
+    state = dict(
+        node_assign=jnp.zeros(n, jnp.int32),
+        hist=hist_store,
+        best=best,
+        leaf_depth=jnp.zeros(L, jnp.int32),
+        leaf_value=jnp.zeros(L, jnp.float32),
+        leaf_count=jnp.zeros(L, jnp.float32).at[0].set(tot[2]),
+        leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(tot[1]),
+        leaf_sum_g=jnp.zeros(L, jnp.float32).at[0].set(tot[0]),
+        leaf_lo=jnp.full(L, NEG_INF, jnp.float32),
+        leaf_hi=jnp.full(L, -NEG_INF, jnp.float32),
+        leaf_parent=jnp.full(L, -1, jnp.int32),     # node that created the leaf
+        leaf_is_left=jnp.zeros(L, bool),
+        node_feature=jnp.full(L - 1, -1, jnp.int32),
+        node_threshold=jnp.zeros(L - 1, jnp.int32),
+        node_default_left=jnp.zeros(L - 1, bool),
+        node_is_cat=jnp.zeros(L - 1, bool),
+        node_gain=jnp.zeros(L - 1, jnp.float32),
+        node_parent=jnp.full(L - 1, -1, jnp.int32),  # parent internal node
+        node_is_left=jnp.zeros(L - 1, bool),
+        node_value=jnp.zeros(L - 1, jnp.float32),
+        node_count=jnp.zeros(L - 1, jnp.float32),
+        num_leaves=jnp.int32(1),
+    )
+
+    def split_step(j, st):
+        bestg = jnp.where(jnp.arange(L) < st["num_leaves"], st["best"].gain, NEG_INF)
+        leaf = jnp.argmax(bestg).astype(jnp.int32)
+        gain = bestg[leaf]
+
+        def do_split(st):
+            b = st["best"]
+            feat = b.feature[leaf]
+            thr = b.threshold[leaf]
+            dleft = b.default_left[leaf]
+            f_is_cat = is_categorical[feat]
+            new_id = st["num_leaves"]
+
+            # --- update node arrays + parent linkage ---
+            parent_node = st["leaf_parent"][leaf]
+            st_nf = st["node_feature"].at[j].set(feat)
+            st_nt = st["node_threshold"].at[j].set(thr)
+            st_nd = st["node_default_left"].at[j].set(dleft)
+            st_nc = st["node_is_cat"].at[j].set(f_is_cat)
+            st_ng = st["node_gain"].at[j].set(gain)
+            st_np = st["node_parent"].at[j].set(parent_node)
+            st_nl = st["node_is_left"].at[j].set(st["leaf_is_left"][leaf])
+            st_nv = st["node_value"].at[j].set(leaf_output(
+                st["leaf_sum_g"][leaf], st["leaf_weight"][leaf], p,
+                0.0, st["leaf_count"][leaf]))
+            st_ncount = st["node_count"].at[j].set(st["leaf_count"][leaf])
+
+            # --- partition rows of this leaf ---
+            col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+            is_miss = (col == nan_bins[feat]) & (nan_bins[feat] >= 0)
+            goes_left = jnp.where(
+                f_is_cat, col == thr,
+                jnp.where(is_miss, dleft, col <= thr))
+            in_leaf = st["node_assign"] == leaf
+            node_assign = jnp.where(in_leaf & ~goes_left, new_id, st["node_assign"])
+
+            # --- child histograms: compute smaller, subtract for larger ---
+            left_smaller = b.lc[leaf] <= b.rc[leaf]
+            small_mask = jnp.where(in_leaf & (goes_left == left_smaller),
+                                   row_weight, 0.0)
+            small_hist = hist_of(small_mask)
+            parent_hist = st["hist"][leaf]
+            large_hist = parent_hist - small_hist
+            lhist = jnp.where(left_smaller, small_hist, large_hist)
+            rhist = parent_hist - lhist
+            hist = st["hist"].at[leaf].set(lhist).at[new_id].set(rhist)
+
+            # --- child bookkeeping ---
+            depth = st["leaf_depth"][leaf] + 1
+            leaf_depth = st["leaf_depth"].at[leaf].set(depth).at[new_id].set(depth)
+            leaf_value = st["leaf_value"].at[leaf].set(b.lout[leaf]).at[new_id].set(b.rout[leaf])
+            leaf_count = st["leaf_count"].at[leaf].set(b.lc[leaf]).at[new_id].set(b.rc[leaf])
+            leaf_weight = st["leaf_weight"].at[leaf].set(b.lh[leaf]).at[new_id].set(b.rh[leaf])
+            leaf_sum_g = st["leaf_sum_g"].at[leaf].set(b.lg[leaf]).at[new_id].set(b.rg[leaf])
+            leaf_parent = st["leaf_parent"].at[leaf].set(j).at[new_id].set(j)
+            leaf_is_left = st["leaf_is_left"].at[leaf].set(True).at[new_id].set(False)
+
+            # monotone (basic): children inherit bounds; split on a monotone
+            # feature pinches them at the midpoint of the child outputs
+            mono = monotone[feat]
+            lo, hi = st["leaf_lo"][leaf], st["leaf_hi"][leaf]
+            mid = (b.lout[leaf] + b.rout[leaf]) * 0.5
+            l_lo = jnp.where(mono < 0, jnp.maximum(lo, mid), lo)
+            l_hi = jnp.where(mono > 0, jnp.minimum(hi, mid), hi)
+            r_lo = jnp.where(mono > 0, jnp.maximum(lo, mid), lo)
+            r_hi = jnp.where(mono < 0, jnp.minimum(hi, mid), hi)
+            leaf_lo = st["leaf_lo"].at[leaf].set(l_lo).at[new_id].set(r_lo)
+            leaf_hi = st["leaf_hi"].at[leaf].set(l_hi).at[new_id].set(r_hi)
+
+            # --- new best splits for both children ---
+            fmask = node_feature_mask(j + 1)
+            depth_ok = (cfg.max_depth <= 0) | (depth < cfg.max_depth)
+
+            def child_best(hist_c, g, h, c, lo_, hi_):
+                s = find_best_split(hist_c, num_bins, default_bins, nan_bins,
+                                    is_categorical, monotone, g, h, c, p, fmask,
+                                    0.0, lo_, hi_)
+                return s._replace(gain=jnp.where(depth_ok, s.gain, NEG_INF))
+
+            sl = child_best(lhist, b.lg[leaf], b.lh[leaf], b.lc[leaf], l_lo, l_hi)
+            sr = child_best(rhist, b.rg[leaf], b.rh[leaf], b.rc[leaf], r_lo, r_hi)
+            best = st["best"].set_leaf(leaf, sl).set_leaf(new_id, sr)
+
+            return dict(
+                node_assign=node_assign, hist=hist, best=best,
+                leaf_depth=leaf_depth, leaf_value=leaf_value,
+                leaf_count=leaf_count, leaf_weight=leaf_weight,
+                leaf_sum_g=leaf_sum_g, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
+                leaf_parent=leaf_parent, leaf_is_left=leaf_is_left,
+                node_feature=st_nf, node_threshold=st_nt,
+                node_default_left=st_nd, node_is_cat=st_nc, node_gain=st_ng,
+                node_parent=st_np, node_is_left=st_nl, node_value=st_nv,
+                node_count=st_ncount,
+                num_leaves=st["num_leaves"] + 1,
+            )
+
+        return jax.lax.cond(gain > 0.0, do_split, lambda s: s, st)
+
+    state = jax.lax.fori_loop(0, L - 1, split_step, state)
+
+    # ---- reconstruct child pointers ----------------------------------------
+    # node j's children: initially leaves (~leaf ids); later splits of those
+    # leaves overwrite with internal node ids.
+    left_child = jnp.full(L - 1, -1, jnp.int32)
+    right_child = jnp.full(L - 1, -1, jnp.int32)
+
+    def scatter_claims(child, idx, cond, val):
+        # route non-claiming writes out of bounds so they are dropped —
+        # each (node, side) slot has exactly one final claimant
+        return child.at[jnp.where(cond, idx, L)].set(val, mode="drop")
+
+    # leaves claim the slot of their creating node
+    leaf_ids = jnp.arange(L, dtype=jnp.int32)
+    lp = state["leaf_parent"]
+    valid_leaf = lp >= 0
+    left_child = scatter_claims(left_child, lp, valid_leaf & state["leaf_is_left"], ~leaf_ids)
+    right_child = scatter_claims(right_child, lp, valid_leaf & ~state["leaf_is_left"], ~leaf_ids)
+    # internal nodes overwrite the slot they were grown from
+    node_ids = jnp.arange(L - 1, dtype=jnp.int32)
+    npar = state["node_parent"]
+    valid_node = (npar >= 0) & (state["node_feature"] >= 0)
+    left_child = scatter_claims(left_child, npar, valid_node & state["node_is_left"], node_ids)
+    right_child = scatter_claims(right_child, npar, valid_node & ~state["node_is_left"], node_ids)
+
+    tree = TreeArrays(
+        split_feature=state["node_feature"],
+        threshold=state["node_threshold"],
+        default_left=state["node_default_left"],
+        is_cat_split=state["node_is_cat"],
+        split_gain=state["node_gain"],
+        left_child=left_child,
+        right_child=right_child,
+        leaf_value=state["leaf_value"],
+        leaf_count=state["leaf_count"],
+        leaf_weight=state["leaf_weight"],
+        internal_value=state["node_value"],
+        internal_count=state["node_count"],
+        num_leaves=state["num_leaves"],
+    )
+    return tree, state["node_assign"]

@@ -1,0 +1,49 @@
+"""Device-side tree traversal over binned data.
+
+Used for training/validation score updates: validation sets are binned with
+the training set's mappers, so bin-threshold comparison is exactly equivalent
+to the reference's raw-value traversal (``tree.h:133``), but vectorized over
+all rows with a ``lax.while_loop`` instead of per-row recursion.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .grower import TreeArrays
+
+
+def predict_leaf_binned(tree: TreeArrays, bins: jax.Array, nan_bins: jax.Array
+                        ) -> jax.Array:
+    """Leaf index per row for binned features ``[N, F]``."""
+    n = bins.shape[0]
+
+    def cond(cur):
+        return jnp.any(cur >= 0)
+
+    def body(cur):
+        node = jnp.maximum(cur, 0)
+        feat = tree.split_feature[node]                      # [N]
+        col = jnp.take_along_axis(bins, feat[:, None].astype(jnp.int32), axis=1
+                                  )[:, 0].astype(jnp.int32)  # [N]
+        thr = tree.threshold[node]
+        is_cat = tree.is_cat_split[node]
+        dleft = tree.default_left[node]
+        nb = nan_bins[feat]
+        is_miss = (col == nb) & (nb >= 0)
+        goes_left = jnp.where(is_cat, col == thr,
+                              jnp.where(is_miss, dleft, col <= thr))
+        nxt = jnp.where(goes_left, tree.left_child[node], tree.right_child[node])
+        return jnp.where(cur >= 0, nxt, cur)
+
+    has_splits = tree.num_leaves > 1
+    init = jnp.where(has_splits, jnp.zeros(n, jnp.int32), jnp.full(n, -1, jnp.int32))
+    final = jax.lax.while_loop(cond, body, init)
+    return (~final).astype(jnp.int32)
+
+
+def add_score_from_leaves(score: jax.Array, leaf_idx: jax.Array,
+                          leaf_value: jax.Array) -> jax.Array:
+    """Score update by leaf gather (the reference's by-partition
+    ``ScoreUpdater::AddScore``, ``score_updater.hpp:88``)."""
+    return score + leaf_value[leaf_idx]

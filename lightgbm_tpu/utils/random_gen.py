@@ -1,0 +1,56 @@
+"""Deterministic RNG utilities.
+
+The reference uses a tiny per-block linear-congruential RNG (``utils/random.h``)
+so bagging/sampling is reproducible regardless of thread count
+(``src/boosting/gbdt.cpp:190``).  The TPU-native equivalent is simpler and
+stronger: ``jax.random`` keys are already counter-based and order-independent,
+so per-block determinism falls out of key folding.  We keep a small host-side
+LCG with the same contract for host code paths (bin sampling, cv folds).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+
+class Random:
+    """Host-side deterministic RNG (next_short/next_int/sample contract of
+    the reference's ``Random`` class, ``utils/random.h``)."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self._state = np.uint32(seed if seed >= 0 else 0)
+
+    def next_short(self, lo: int, hi: int) -> int:
+        return lo + self._rand16() % (hi - lo)
+
+    def next_int(self, lo: int, hi: int) -> int:
+        r = (np.uint32(self._rand16()) << np.uint32(16)) | np.uint32(self._rand16())
+        return int(lo + r % np.uint32(hi - lo))
+
+    def next_float(self) -> float:
+        return self._rand16() / 65536.0
+
+    def _rand16(self) -> int:
+        # LCG constants as in C++ minstd-style generators; value truncated to 16 bits.
+        self._state = np.uint32((int(self._state) * 214013 + 2531011) & 0xFFFFFFFF)
+        return int((int(self._state) >> 16) & 0x7FFF)
+
+    def sample(self, total: int, k: int) -> np.ndarray:
+        """Reservoir-free sorted sampling of k indices out of total (matches the
+        reference contract of Random::Sample: sorted unique indices)."""
+        if k >= total:
+            return np.arange(total, dtype=np.int64)
+        rng = np.random.default_rng(int(self._state))
+        idx = rng.choice(total, size=k, replace=False)
+        idx.sort()
+        return idx.astype(np.int64)
+
+
+def key_for_iteration(seed: int, iteration: int, salt: int = 0) -> jax.Array:
+    """Per-iteration PRNG key: deterministic in (seed, iteration) and
+    independent of device count — the TPU analog of per-block RNG streams."""
+    key = jax.random.key(np.uint32(seed))
+    key = jax.random.fold_in(key, np.uint32(iteration))
+    if salt:
+        key = jax.random.fold_in(key, np.uint32(salt))
+    return key

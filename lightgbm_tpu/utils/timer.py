@@ -1,0 +1,55 @@
+"""String-keyed hierarchical wall-clock timer.
+
+Parity with the reference's ``Common::Timer`` / ``FunctionTimer``
+(``include/LightGBM/utils/common.h:931,995``): named accumulating scopes and an
+aggregate printout.  On TPU the heavyweight profiling story is
+``jax.profiler``; this host timer exists for quick parity-style breakdowns of
+the boosting loop.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+from .log import Log
+
+
+class Timer:
+    def __init__(self) -> None:
+        self._acc: dict[str, float] = defaultdict(float)
+        self._count: dict[str, int] = defaultdict(int)
+        self._start: dict[str, float] = {}
+
+    def start(self, name: str) -> None:
+        self._start[name] = time.perf_counter()
+
+    def stop(self, name: str) -> None:
+        t0 = self._start.pop(name, None)
+        if t0 is not None:
+            self._acc[name] += time.perf_counter() - t0
+            self._count[name] += 1
+
+    @contextlib.contextmanager
+    def scope(self, name: str):
+        self.start(name)
+        try:
+            yield
+        finally:
+            self.stop(name)
+
+    def items(self):
+        return sorted(self._acc.items(), key=lambda kv: -kv[1])
+
+    def reset(self) -> None:
+        self._acc.clear()
+        self._count.clear()
+        self._start.clear()
+
+    def print(self) -> None:
+        for name, secs in self.items():
+            Log.debug("%s: %.3fs (%d calls)", name, secs, self._count[name])
+
+
+#: process-global timer, mirroring the reference's ``global_timer``
+global_timer = Timer()

@@ -1,0 +1,48 @@
+"""Test configuration: force an 8-device virtual CPU mesh so sharding tests
+run without TPU hardware (SURVEY.md §4 implication)."""
+import os
+
+# force CPU: the ambient environment may pin JAX_PLATFORMS to a remote TPU
+# backend (axon tunnel) which must not be touched by unit tests
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# a sitecustomize may have pre-registered remote TPU backend factories (and
+# read JAX_PLATFORMS) before this conftest runs; drop them and re-pin the
+# already-imported jax config so no test can accidentally touch hardware
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+for _plat in list(_xb._backend_factories):
+    if _plat != "cpu":
+        _xb._backend_factories.pop(_plat, None)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def binary_data():
+    from sklearn.datasets import make_classification
+    X, y = make_classification(n_samples=2000, n_features=10, n_informative=6,
+                               random_state=42)
+    return X[:1500], y[:1500], X[1500:], y[1500:]
+
+
+@pytest.fixture(scope="session")
+def regression_data():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(2000, 8))
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 3) + X[:, 2] * X[:, 3]
+         + 0.1 * rng.normal(size=2000)).astype(np.float64)
+    return X[:1500], y[:1500], X[500:], y[500:]
+
+
+@pytest.fixture(scope="session")
+def multiclass_data():
+    from sklearn.datasets import make_classification
+    X, y = make_classification(n_samples=2400, n_features=12, n_informative=8,
+                               n_classes=4, n_clusters_per_class=1, random_state=3)
+    return X[:1800], y[:1800], X[1800:], y[1800:]

@@ -1,0 +1,96 @@
+"""Dataset construction / binning / field get-set / binary round trip
+(shape of reference tests/python_package_test/test_basic.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.bin import BinMapper, BinType, MissingType
+from lightgbm_tpu.io.dataset import Dataset as InnerDataset
+from lightgbm_tpu.config import Config
+
+
+def test_config_aliases():
+    cfg = Config.from_params({"n_estimators": 50, "eta": 0.3, "num_leaf": 7,
+                              "min_child_samples": 3})
+    assert cfg.num_iterations == 50
+    assert cfg.learning_rate == 0.3
+    assert cfg.num_leaves == 7
+    assert cfg.min_data_in_leaf == 3
+
+
+def test_config_conflicts():
+    with pytest.raises(lgb.LightGBMError):
+        Config.from_params({"boosting": "nope"})
+    with pytest.raises(lgb.LightGBMError):
+        Config.from_params({"is_unbalance": True, "scale_pos_weight": 2.0})
+    cfg = Config.from_params({"max_depth": 3, "num_leaves": 100})
+    assert cfg.num_leaves == 8
+
+
+def test_bin_mapper_numeric():
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=5000)
+    m = BinMapper.find_bin(vals, 5000, max_bin=255, min_data_in_bin=3,
+                           min_split_data=20, pre_filter=True)
+    bins = m.value_to_bin(vals)
+    assert bins.min() >= 0 and bins.max() < m.num_bin
+    # bins should be monotone in value
+    order = np.argsort(vals)
+    assert (np.diff(bins[order]) >= 0).all()
+
+
+def test_bin_mapper_missing_nan():
+    vals = np.array([1.0, 2.0, np.nan, 3.0, np.nan, 4.0] * 50)
+    m = BinMapper.find_bin(vals, len(vals), 255, 1, 1, True,
+                           use_missing=True)
+    assert m.missing_type == MissingType.NAN
+    bins = m.value_to_bin(np.array([1.0, np.nan]))
+    assert bins[1] == m.num_bin - 1  # NaN -> trailing bin
+
+
+def test_bin_mapper_categorical():
+    vals = np.array([1, 2, 2, 3, 3, 3, 7, 7, 7, 7] * 20, dtype=np.float64)
+    m = BinMapper.find_bin(vals, len(vals), 255, 1, 1, True,
+                           bin_type=BinType.CATEGORICAL)
+    bins = m.value_to_bin(np.array([7.0, 3.0, 2.0, 1.0, 99.0]))
+    assert bins[0] == 1          # most frequent category -> bin 1
+    assert bins[4] == 0          # unseen -> bin 0
+
+
+def test_trivial_feature_dropped():
+    X = np.column_stack([np.ones(100), np.arange(100, dtype=float)])
+    ds = InnerDataset.from_data(X, Config(), label=np.arange(100, dtype=np.float32))
+    assert ds.num_features == 1
+    assert ds.used_features == [1]
+
+
+def test_dataset_fields(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    ds = lgb.Dataset(Xtr, label=ytr)
+    ds.construct()
+    np.testing.assert_allclose(ds.get_label(), ytr.astype(np.float32))
+    w = np.random.default_rng(0).uniform(0.5, 1.5, len(ytr)).astype(np.float32)
+    ds.set_weight(w)
+    np.testing.assert_allclose(ds.get_weight(), w)
+    assert ds.num_data() == len(ytr)
+    assert ds.num_feature() == Xtr.shape[1]
+
+
+def test_dataset_binary_roundtrip(tmp_path, binary_data):
+    Xtr, ytr, _, _ = binary_data
+    ds = lgb.Dataset(Xtr, label=ytr).construct()
+    path = str(tmp_path / "data.bin")
+    ds.save_binary(path)
+    loaded = InnerDataset.load_binary(path)
+    np.testing.assert_array_equal(loaded.bins, ds._inner.bins)
+    np.testing.assert_allclose(loaded.metadata.label, ds._inner.metadata.label)
+
+
+def test_subset(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    ds = lgb.Dataset(Xtr, label=ytr).construct()
+    sub = ds.subset(np.arange(100)).construct()
+    assert sub.num_data() == 100
+    np.testing.assert_array_equal(sub._inner.bins, ds._inner.bins[:100])

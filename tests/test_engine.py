@@ -1,0 +1,252 @@
+"""Integration tests at the Python API level (shape of the reference
+``tests/python_package_test/test_engine.py``): train on small datasets,
+assert metric thresholds or structural properties."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _auc(y, p):
+    from sklearn.metrics import roc_auc_score
+    return roc_auc_score(y, p)
+
+
+def test_binary(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    valid = train.create_valid(Xte, label=yte)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "metric": "auc", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": 0},
+                    train, num_boost_round=30, valid_sets=[valid],
+                    evals_result=evals, verbose_eval=False)
+    pred = bst.predict(Xte)
+    auc = _auc(yte, pred)
+    assert auc > 0.95
+    # device-side valid score must match host raw prediction path
+    assert evals["valid_0"]["auc"][-1] == pytest.approx(auc, abs=1e-6)
+    assert (pred >= 0).all() and (pred <= 1).all()
+
+
+def test_regression(regression_data):
+    Xtr, ytr, Xte, yte = regression_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "min_data_in_leaf": 5, "verbosity": 0},
+                    train, num_boost_round=50, verbose_eval=False)
+    pred = bst.predict(Xte)
+    mse = float(np.mean((pred - yte) ** 2))
+    base = float(np.var(yte))
+    assert mse < base * 0.2
+
+
+def test_regression_l1(regression_data):
+    Xtr, ytr, Xte, yte = regression_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "regression_l1", "num_leaves": 15,
+                     "verbosity": 0}, train, num_boost_round=40,
+                    verbose_eval=False)
+    mae = float(np.mean(np.abs(bst.predict(Xte) - yte)))
+    base = float(np.mean(np.abs(yte - np.median(ytr))))
+    assert mae < base * 0.5
+
+
+def test_multiclass(multiclass_data):
+    Xtr, ytr, Xte, yte = multiclass_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "multiclass", "num_class": 4,
+                     "num_leaves": 15, "verbosity": 0},
+                    train, num_boost_round=30, verbose_eval=False)
+    pred = bst.predict(Xte)
+    assert pred.shape == (len(yte), 4)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-5)
+    acc = float(np.mean(np.argmax(pred, axis=1) == yte))
+    assert acc > 0.8
+
+
+def test_early_stopping(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    valid = train.create_valid(Xte, label=yte)
+    bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                     "num_leaves": 63, "learning_rate": 0.5, "verbosity": 0},
+                    train, num_boost_round=200, valid_sets=[valid],
+                    early_stopping_rounds=5, verbose_eval=False)
+    assert 0 < bst.best_iteration < 200
+
+
+def test_missing_values(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    Xtr = Xtr.copy()
+    Xte = Xte.copy()
+    rng = np.random.default_rng(0)
+    Xtr[rng.uniform(size=Xtr.shape) < 0.2] = np.nan
+    Xte[rng.uniform(size=Xte.shape) < 0.2] = np.nan
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": 0},
+                    train, num_boost_round=30, verbose_eval=False)
+    auc = _auc(yte, bst.predict(Xte))
+    assert auc > 0.85
+
+
+def test_categorical_feature():
+    rng = np.random.default_rng(1)
+    n = 3000
+    cat = rng.integers(0, 10, size=n)
+    noise = rng.normal(size=n) * 0.1
+    y = (np.isin(cat, [2, 5, 7]).astype(float) + noise > 0.5).astype(int)
+    X = np.column_stack([cat.astype(float), rng.normal(size=n)])
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": 0,
+                     "min_data_in_leaf": 5}, train, num_boost_round=30,
+                    verbose_eval=False)
+    auc = _auc(y, bst.predict(X))
+    assert auc > 0.95
+
+
+def test_bagging(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "bagging_fraction": 0.5, "bagging_freq": 1,
+                     "feature_fraction": 0.7, "verbosity": 0},
+                    train, num_boost_round=30, verbose_eval=False)
+    assert _auc(yte, bst.predict(Xte)) > 0.9
+
+
+def test_goss(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "binary", "boosting": "goss",
+                     "num_leaves": 15, "verbosity": 0},
+                    train, num_boost_round=30, verbose_eval=False)
+    assert _auc(yte, bst.predict(Xte)) > 0.9
+
+
+def test_dart(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "binary", "boosting": "dart",
+                     "num_leaves": 15, "verbosity": 0},
+                    train, num_boost_round=20, verbose_eval=False)
+    assert _auc(yte, bst.predict(Xte)) > 0.9
+
+
+def test_rf(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "binary", "boosting": "rf",
+                     "bagging_fraction": 0.7, "bagging_freq": 1,
+                     "feature_fraction": 0.7,
+                     "num_leaves": 31, "verbosity": 0},
+                    train, num_boost_round=20, verbose_eval=False)
+    assert _auc(yte, bst.predict(Xte)) > 0.9
+
+
+def test_model_io_roundtrip(tmp_path, binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": 0},
+                    train, num_boost_round=10, verbose_eval=False)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(loaded.predict(Xte), bst.predict(Xte),
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_custom_objective(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+
+    def logloss_obj(score, dataset):
+        y = ytr
+        p = 1.0 / (1.0 + np.exp(-score))
+        return p - y, p * (1 - p)
+
+    bst = lgb.train({"num_leaves": 15, "verbosity": 0, "objective": "none"},
+                    train, num_boost_round=30, fobj=logloss_obj,
+                    verbose_eval=False)
+    pred = bst.predict(Xte, raw_score=True)
+    assert _auc(yte, pred) > 0.9
+
+
+def test_weights(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    w = np.where(ytr > 0, 2.0, 1.0).astype(np.float32)
+    train = lgb.Dataset(Xtr, label=ytr, weight=w)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": 0},
+                    train, num_boost_round=20, verbose_eval=False)
+    assert _auc(yte, bst.predict(Xte)) > 0.9
+
+
+def test_feature_importance(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": 0},
+                    train, num_boost_round=10, verbose_eval=False)
+    imp_split = bst.feature_importance("split")
+    imp_gain = bst.feature_importance("gain")
+    assert imp_split.shape == (Xtr.shape[1],)
+    assert imp_split.sum() > 0
+    assert imp_gain.sum() > 0
+
+
+def test_cv(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    res = lgb.cv({"objective": "binary", "metric": "auc", "num_leaves": 15,
+                  "verbosity": 0}, train, num_boost_round=10, nfold=3)
+    assert "valid auc-mean" in res
+    assert len(res["valid auc-mean"]) == 10
+    assert res["valid auc-mean"][-1] > 0.9
+
+
+def test_max_depth(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "binary", "num_leaves": 63, "max_depth": 3,
+                     "verbosity": 0}, train, num_boost_round=5,
+                    verbose_eval=False)
+    dump = bst.dump_model()
+    def depth_of(node, d=0):
+        if "leaf_value" in node and "split_feature" not in node:
+            return d
+        return max(depth_of(node["left_child"], d + 1),
+                   depth_of(node["right_child"], d + 1))
+    for ti in dump["tree_info"]:
+        assert depth_of(ti["tree_structure"]) <= 3
+
+
+def test_monotone_constraints_engine():
+    rng = np.random.default_rng(5)
+    n = 2000
+    x0 = rng.uniform(-1, 1, n)
+    x1 = rng.normal(size=n)
+    y = 3 * x0 + np.sin(3 * x1) + 0.1 * rng.normal(size=n)
+    X = np.column_stack([x0, x1])
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "monotone_constraints": [1, 0], "verbosity": 0},
+                    train, num_boost_round=30, verbose_eval=False)
+    # predictions must be monotone non-decreasing in x0 at fixed x1
+    grid = np.linspace(-1, 1, 50)
+    for x1v in [-1.0, 0.0, 1.0]:
+        Xg = np.column_stack([grid, np.full(50, x1v)])
+        pg = bst.predict(Xg)
+        assert (np.diff(pg) >= -1e-9).all()
+
+
+def test_record_and_reset_lr(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    valid = train.create_valid(Xte, label=yte)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "metric": "auc", "num_leaves": 7,
+                     "verbosity": 0},
+                    train, num_boost_round=10, valid_sets=[valid],
+                    callbacks=[lgb.reset_parameter(
+                        learning_rate=lambda i: 0.1 * (0.99 ** i))],
+                    evals_result=evals, verbose_eval=False)
+    assert len(evals["valid_0"]["auc"]) == 10

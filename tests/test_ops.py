@@ -1,0 +1,138 @@
+"""Unit tests for the compute ops: histogram kernels and split search."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.histogram import build_histogram
+from lightgbm_tpu.ops.split import SplitParams, find_best_split, leaf_output
+
+
+def _ref_histogram(bins, grad, hess, mask, max_bin):
+    n, f = bins.shape
+    out = np.zeros((f, max_bin, 3))
+    for i in range(n):
+        if mask[i] == 0:
+            continue
+        for j in range(f):
+            b = bins[i, j]
+            out[j, b, 0] += grad[i] * mask[i]
+            out[j, b, 1] += hess[i] * mask[i]
+            out[j, b, 2] += mask[i]
+    return out
+
+
+@pytest.mark.parametrize("method", ["onehot", "scatter"])
+def test_histogram_matches_reference(method):
+    rng = np.random.default_rng(0)
+    n, f, b = 500, 4, 16
+    bins = rng.integers(0, b, size=(n, f)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+    mask = (rng.uniform(size=n) < 0.7).astype(np.float32)
+    got = np.asarray(build_histogram(jnp.asarray(bins), jnp.asarray(grad),
+                                     jnp.asarray(hess), jnp.asarray(mask), b,
+                                     method=method, chunk_rows=128))
+    want = _ref_histogram(bins, grad, hess, mask, b)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def _default_params(**kw):
+    d = dict(lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=1,
+             min_sum_hessian_in_leaf=0.0, min_gain_to_split=0.0,
+             max_delta_step=0.0, path_smooth=0.0, cat_smooth=10.0,
+             cat_l2=10.0, max_cat_to_onehot=4)
+    d.update(kw)
+    return SplitParams(**d)
+
+
+def _split_inputs(hist, num_bins):
+    f = hist.shape[0]
+    return dict(
+        hist=jnp.asarray(hist, jnp.float32),
+        num_bins=jnp.asarray(num_bins, jnp.int32),
+        default_bins=jnp.zeros(f, jnp.int32),
+        nan_bins=jnp.full(f, -1, jnp.int32),
+        is_categorical=jnp.zeros(f, bool),
+        monotone=jnp.zeros(f, jnp.int8),
+        feature_mask=jnp.ones(f, jnp.float32),
+    )
+
+
+def test_split_finds_obvious_boundary():
+    # feature 0: bins 0-3, gradient +1 for bins 0,1 and -1 for bins 2,3
+    b = 8
+    hist = np.zeros((2, b, 3))
+    for bin_id, g in [(0, 10.0), (1, 10.0), (2, -10.0), (3, -10.0)]:
+        hist[0, bin_id] = [g, 10.0, 10.0]
+    # feature 1: no signal
+    hist[1, 0] = [0.0, 40.0, 40.0]
+    inp = _split_inputs(hist, [4, 1])
+    p = _default_params()
+    s = find_best_split(**inp, sum_g=0.0, sum_h=40.0, count=40.0, p=p)
+    assert int(s.feature) == 0
+    assert int(s.threshold) == 1          # bins <= 1 go left
+    assert float(s.gain) > 0
+    assert float(s.left_sum_g) == pytest.approx(20.0)
+    assert float(s.left_output) == pytest.approx(-1.0)   # -G/H
+    assert float(s.right_output) == pytest.approx(1.0)
+
+
+def test_split_min_data_gate():
+    b = 4
+    hist = np.zeros((1, b, 3))
+    hist[0, 0] = [5.0, 2.0, 2.0]
+    hist[0, 1] = [-5.0, 38.0, 38.0]
+    inp = _split_inputs(hist, [2])
+    s = find_best_split(**inp, sum_g=0.0, sum_h=40.0, count=40.0,
+                        p=_default_params(min_data_in_leaf=5))
+    assert float(s.gain) < 0  # blocked: left side has only 2 rows
+
+
+def test_split_l2_shrinks_gain():
+    b = 4
+    hist = np.zeros((1, b, 3))
+    hist[0, 0] = [10.0, 10.0, 10.0]
+    hist[0, 1] = [-10.0, 10.0, 10.0]
+    inp = _split_inputs(hist, [2])
+    s0 = find_best_split(**inp, sum_g=0.0, sum_h=20.0, count=20.0, p=_default_params())
+    s1 = find_best_split(**inp, sum_g=0.0, sum_h=20.0, count=20.0,
+                         p=_default_params(lambda_l2=10.0))
+    assert float(s1.gain) < float(s0.gain)
+
+
+def test_split_missing_direction():
+    # NaN bin (last) holds strongly-negative-gradient rows: best with
+    # missing going right toward the negative side
+    b = 8
+    f = 1
+    hist = np.zeros((f, b, 3))
+    hist[0, 0] = [10.0, 10.0, 10.0]
+    hist[0, 1] = [-2.0, 10.0, 10.0]
+    hist[0, 3] = [-8.0, 5.0, 5.0]     # NaN bin (num_bin=4 -> nan bin idx 3)
+    inp = _split_inputs(hist, [4])
+    inp["nan_bins"] = jnp.asarray([3], jnp.int32)
+    s = find_best_split(**inp, sum_g=0.0, sum_h=25.0, count=25.0, p=_default_params())
+    assert float(s.gain) > 0
+    assert not bool(s.default_left)   # missing joins the negative (right) side
+
+
+def test_monotone_rejects_violation():
+    b = 4
+    hist = np.zeros((1, b, 3))
+    # increasing feature -> decreasing output (violates +1 monotone)
+    hist[0, 0] = [-10.0, 10.0, 10.0]   # left output +1
+    hist[0, 1] = [10.0, 10.0, 10.0]    # right output -1
+    inp = _split_inputs(hist, [2])
+    inp["monotone"] = jnp.asarray([1], jnp.int8)
+    s = find_best_split(**inp, sum_g=0.0, sum_h=20.0, count=20.0, p=_default_params())
+    assert float(s.gain) < 0
+    inp["monotone"] = jnp.asarray([-1], jnp.int8)
+    s = find_best_split(**inp, sum_g=0.0, sum_h=20.0, count=20.0, p=_default_params())
+    assert float(s.gain) > 0
+
+
+def test_leaf_output_l1():
+    p = _default_params(lambda_l1=5.0)
+    assert float(leaf_output(10.0, 10.0, p)) == pytest.approx(-0.5)
+    assert float(leaf_output(3.0, 10.0, p)) == pytest.approx(0.0)
