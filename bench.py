@@ -1,0 +1,85 @@
+"""Headline benchmark: Higgs-shape binary classification training throughput.
+
+Mirrors the reference's benchmark config (``docs/Experiments.rst:82-91``:
+255 leaves, lr=0.1, max_bin=255) on a synthetic dataset with Higgs geometry
+(28 dense numeric features).  The reference's published number is 130.094 s
+for 500 iterations over 10.5M rows on a 2x Xeon E5-2690v4
+(``docs/Experiments.rst:113``), i.e. 40.36M row-iterations/sec — that is the
+``vs_baseline`` denominator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# reference throughput: 10.5M rows * 500 iters / 130.094 s  (Experiments.rst:113)
+_REF_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 130.094
+
+
+def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 42):
+    """Synthetic stand-in with Higgs geometry (dense floats, ~even classes)."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n_rows, n_feat)).astype(np.float32)
+    # nonlinear signal over a few features so trees have structure to find
+    logit = (1.2 * X[:, 0] - 0.8 * X[:, 1] + X[:, 2] * X[:, 3]
+             + 0.5 * np.sin(3.0 * X[:, 4]) + 0.3 * X[:, 5] ** 2)
+    y = (logit + rng.logistic(size=n_rows) > 0).astype(np.float32)
+    return X, y
+
+
+def main() -> None:
+    n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    n_iters = int(os.environ.get("BENCH_ITERS", 20))
+    n_warmup = int(os.environ.get("BENCH_WARMUP", 2))
+    num_leaves = int(os.environ.get("BENCH_LEAVES", 255))
+
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(n_rows)
+    params = {
+        "objective": "binary",
+        "num_leaves": num_leaves,
+        "learning_rate": 0.1,
+        "max_bin": 255,
+        "min_data_in_leaf": 100,
+        "min_sum_hessian_in_leaf": 100.0,
+        "verbose": -1,
+    }
+    train_set = lgb.Dataset(X, label=y, params=params)
+    booster = lgb.Booster(params=params, train_set=train_set)
+
+    # warmup covers compilation (first grow + first score update)
+    for _ in range(n_warmup):
+        booster.update()
+    booster._gbdt._train_score.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        booster.update()
+    booster._gbdt._train_score.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    sec_per_tree = elapsed / n_iters
+    row_iters_per_sec = n_rows * n_iters / elapsed
+    print(json.dumps({
+        "metric": "higgs_1m_train_throughput",
+        "value": round(row_iters_per_sec / 1e6, 4),
+        "unit": "Mrow_iters/sec",
+        "vs_baseline": round(row_iters_per_sec / _REF_ROW_ITERS_PER_SEC, 4),
+        "detail": {
+            "rows": n_rows, "iters_timed": n_iters,
+            "num_leaves": num_leaves,
+            "sec_per_tree": round(sec_per_tree, 4),
+            "backend": __import__("jax").default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,10 @@ class GrowerConfig(NamedTuple):
     feature_fraction_bynode: float
     hist_method: str          # 'onehot' | 'scatter'
     hist_chunk_rows: int
+    # data-parallel mesh axis: rows are sharded across this axis and the
+    # reference's histogram ReduceScatter + global-sum collectives
+    # (data_parallel_tree_learner.cpp:155-173, network.h:168) become a psum
+    axis_name: "str | None" = None
 
 
 class TreeArrays(NamedTuple):
@@ -106,9 +110,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     p = cfg.split
 
     def hist_of(mask):
-        return build_histogram(bins, grad, hess, mask, B,
-                               method=cfg.hist_method,
-                               chunk_rows=cfg.hist_chunk_rows)
+        h = build_histogram(bins, grad, hess, mask, B,
+                            method=cfg.hist_method,
+                            chunk_rows=cfg.hist_chunk_rows)
+        if cfg.axis_name is not None:
+            h = jax.lax.psum(h, cfg.axis_name)
+        return h
 
     def node_feature_mask(step):
         if cfg.feature_fraction_bynode >= 1.0:
@@ -123,6 +130,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     # ---- degenerate case: no usable features -> single-leaf tree -----------
     if f == 0:
+        cnt = jnp.sum(row_weight)
+        wgt = jnp.sum(hess * row_weight)
+        if cfg.axis_name is not None:
+            cnt = jax.lax.psum(cnt, cfg.axis_name)
+            wgt = jax.lax.psum(wgt, cfg.axis_name)
         empty = TreeArrays(
             split_feature=jnp.full(L - 1, -1, jnp.int32),
             threshold=jnp.zeros(L - 1, jnp.int32),
@@ -132,8 +144,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             left_child=jnp.full(L - 1, -1, jnp.int32),
             right_child=jnp.full(L - 1, -1, jnp.int32),
             leaf_value=jnp.zeros(L, jnp.float32),
-            leaf_count=jnp.zeros(L, jnp.float32).at[0].set(jnp.sum(row_weight)),
-            leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(jnp.sum(hess * row_weight)),
+            leaf_count=jnp.zeros(L, jnp.float32).at[0].set(cnt),
+            leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(wgt),
             internal_value=jnp.zeros(L - 1, jnp.float32),
             internal_count=jnp.zeros(L - 1, jnp.float32),
             num_leaves=jnp.int32(1))
@@ -143,6 +155,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     root_hist = hist_of(row_weight)
     tot = jnp.stack([jnp.sum(grad * row_weight), jnp.sum(hess * row_weight),
                      jnp.sum(row_weight)])
+    if cfg.axis_name is not None:
+        # root grad/hess sums are global (reference Allreduce,
+        # data_parallel_tree_learner.cpp:126-152)
+        tot = jax.lax.psum(tot, cfg.axis_name)
     root_split = find_best_split(
         root_hist, num_bins, default_bins, nan_bins, is_categorical, monotone,
         tot[0], tot[1], tot[2], p, node_feature_mask(0))

@@ -1,0 +1,23 @@
+"""Distributed training over JAX device meshes.
+
+This package replaces the reference's entire ``src/network/`` layer
+(hand-written Bruck allgather / recursive-halving reduce-scatter over TCP
+sockets or MPI, ``network.cpp``, ``linkers_socket.cpp``, ``linkers_mpi.cpp``)
+and its three parallel tree learners (``src/treelearner/
+{data,feature,voting}_parallel_tree_learner.cpp``) with `shard_map` programs
+over a `jax.sharding.Mesh`, where the communication patterns are single XLA
+collectives riding ICI/DCN:
+
+- histogram ReduceScatter        -> ``lax.psum`` / ``lax.psum_scatter``
+- best-split Allreduce (max)     -> ``lax.pmax`` over a packed (gain, key)
+- scalar GlobalSum / SyncUpBy*   -> ``lax.psum`` / ``lax.pmin`` / ``lax.pmax``
+
+Multi-host bring-up (the reference's machine-list file + port handshake,
+``linkers_socket.cpp``; Dask's cluster setup, ``python-package/lightgbm/
+dask.py``) is ``jax.distributed.initialize`` + the standard TPU pod runtime.
+"""
+from .mesh import default_mesh, init_distributed
+from .data_parallel import make_dp_train_step, pad_rows_to_multiple, shard_rows
+
+__all__ = ["default_mesh", "init_distributed", "make_dp_train_step",
+           "pad_rows_to_multiple", "shard_rows"]

@@ -1,0 +1,55 @@
+"""Device-mesh construction and multi-host initialization.
+
+The reference builds its process mesh by parsing a machine-list file and
+pairwise-connecting TCP sockets (``Linkers::Construct``,
+``src/network/linkers_socket.cpp``) or from ``MPI_COMM_WORLD``
+(``linkers_mpi.cpp``).  Here the runtime owns topology: we only name axes on
+`jax.sharding.Mesh` and let XLA route collectives over ICI/DCN.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+def default_mesh(num_devices: Optional[int] = None,
+                 axis_name: str = DATA_AXIS,
+                 devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    """1-D mesh over (a prefix of) the available devices."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} available")
+        devices = devices[:num_devices]
+    return jax.sharding.Mesh(np.asarray(devices), (axis_name,))
+
+
+def mesh_2d(num_data: int, num_feature: int,
+            devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    """(data, feature) mesh for combined row+feature sharding."""
+    if devices is None:
+        devices = jax.devices()
+    n = num_data * num_feature
+    if n > len(devices):
+        raise ValueError(f"mesh {num_data}x{num_feature} needs {n} devices, "
+                         f"only {len(devices)} available")
+    arr = np.asarray(devices[:n]).reshape(num_data, num_feature)
+    return jax.sharding.Mesh(arr, (DATA_AXIS, FEATURE_AXIS))
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (replaces ``LGBM_NetworkInit`` + machine lists,
+    ``c_api.cpp`` / ``application.cpp:167-202``).  On TPU pods all arguments
+    are discovered from the environment."""
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
