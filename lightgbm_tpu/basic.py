@@ -201,6 +201,9 @@ class Booster:
         data.construct()
         self._gbdt.add_valid_data(data._inner, name)
         self.name_valid_sets.append(name)
+        if not hasattr(self, "valid_sets_py"):
+            self.valid_sets_py: List[Dataset] = []
+        self.valid_sets_py.append(data)
         return self
 
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
@@ -222,6 +225,22 @@ class Booster:
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()
         return self
+
+    # -- pickling: serialize through the model string, like the reference
+    # Booster.__getstate__ (basic.py) -----------------------------------
+    def __getstate__(self):
+        return {"params": self.params,
+                "best_iteration": self.best_iteration,
+                "best_score": self.best_score,
+                "model_str": self.model_to_string()}
+
+    def __setstate__(self, state):
+        self.params = state["params"]
+        self.best_iteration = state["best_iteration"]
+        self.best_score = state["best_score"]
+        self.train_set = None
+        self._network_initialized = False
+        self._load_from_string(state["model_str"])
 
     @property
     def current_iteration(self) -> int:
@@ -261,7 +280,8 @@ class Booster:
                 dataset = self.train_set
             else:
                 score = np.asarray(self._gbdt._valid_scores[idx], np.float64)
-                dataset = None
+                dataset = (self.valid_sets_py[idx]
+                           if getattr(self, "valid_sets_py", None) else None)
             s = score[0] if self._gbdt.num_tree_per_iteration == 1 else score
             res = feval(s, dataset)
             if isinstance(res, tuple):

@@ -1,0 +1,150 @@
+"""sklearn-API conformance tests (mirrors reference test_sklearn.py patterns)."""
+import numpy as np
+import pickle
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import LGBMClassifier, LGBMRegressor, LGBMRanker
+
+
+def test_regressor_basic(regression_data):
+    Xtr, ytr, Xte, yte = regression_data
+    m = LGBMRegressor(n_estimators=30, num_leaves=15, random_state=42)
+    m.fit(Xtr, ytr)
+    pred = m.predict(Xte)
+    mse = float(np.mean((pred - yte) ** 2))
+    var = float(np.var(yte))
+    assert mse < 0.4 * var
+    assert m.score(Xte, yte) > 0.6
+    assert m.n_features_ == Xtr.shape[1]
+    imp = m.feature_importances_
+    assert imp.shape == (Xtr.shape[1],)
+    assert imp.sum() > 0
+
+
+def test_classifier_binary(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    m = LGBMClassifier(n_estimators=30, num_leaves=15)
+    m.fit(Xtr, ytr)
+    assert set(m.classes_) == {0, 1}
+    assert m.n_classes_ == 2
+    proba = m.predict_proba(Xte)
+    assert proba.shape == (len(yte), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+    acc = m.score(Xte, yte)
+    assert acc > 0.8
+    pred = m.predict(Xte)
+    assert pred.dtype == np.asarray(yte).dtype or set(np.unique(pred)) <= {0, 1}
+
+
+def test_classifier_multiclass(multiclass_data):
+    Xtr, ytr, Xte, yte = multiclass_data
+    m = LGBMClassifier(n_estimators=25, num_leaves=15)
+    m.fit(Xtr, ytr)
+    assert m.n_classes_ == 4
+    proba = m.predict_proba(Xte)
+    assert proba.shape == (len(yte), 4)
+    acc = m.score(Xte, yte)
+    assert acc > 0.7
+
+
+def test_classifier_string_labels(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    labels = np.array(["neg", "pos"])
+    m = LGBMClassifier(n_estimators=15, num_leaves=15)
+    m.fit(Xtr, labels[ytr.astype(int)])
+    pred = m.predict(Xte)
+    assert set(np.unique(pred)) <= {"neg", "pos"}
+    acc = float(np.mean(pred == labels[yte.astype(int)]))
+    assert acc > 0.8
+
+
+def test_eval_set_early_stopping(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    m = LGBMClassifier(n_estimators=200, num_leaves=31, learning_rate=0.3)
+    m.fit(Xtr, ytr, eval_set=[(Xte, yte)], eval_metric="binary_logloss",
+          early_stopping_rounds=5)
+    assert m.best_iteration_ > 0
+    assert m.best_iteration_ <= 200
+    assert "valid_0" in m.evals_result_
+    assert "binary_logloss" in m.evals_result_["valid_0"]
+
+
+def test_custom_objective_and_eval(regression_data):
+    Xtr, ytr, Xte, yte = regression_data
+
+    def mse_obj(y_true, y_pred):
+        return (y_pred - y_true), np.ones_like(y_true)
+
+    def mae_eval(y_true, y_pred):
+        return "custom_mae", float(np.mean(np.abs(y_true - y_pred))), False
+
+    m = LGBMRegressor(n_estimators=30, num_leaves=15, objective=mse_obj)
+    m.fit(Xtr, ytr, eval_set=[(Xte, yte)], eval_metric=mae_eval)
+    pred = m.predict(Xte)
+    mse = float(np.mean((pred - yte) ** 2))
+    assert mse < 0.5 * float(np.var(yte))
+    assert "custom_mae" in m.evals_result_["valid_0"]
+
+
+def test_ranker():
+    from tests.test_rank_xentropy import make_ranking
+    X, y, group = make_ranking()
+    split = int(len(group) * 0.8)
+    n_tr = int(group[:split].sum())
+    m = LGBMRanker(n_estimators=20, num_leaves=15, min_child_samples=5)
+    m.fit(X[:n_tr], y[:n_tr], group=group[:split],
+          eval_set=[(X[n_tr:], y[n_tr:])], eval_group=[group[split:]],
+          eval_metric="ndcg")
+    assert any(k.startswith("ndcg@") for k in m.evals_result_["valid_0"])
+    pred = m.predict(X[n_tr:])
+    assert pred.shape == (len(y) - n_tr,)
+    with pytest.raises(lgb.LightGBMError):
+        LGBMRanker().fit(X, y)                     # no group
+
+
+def test_get_set_params():
+    m = LGBMClassifier(num_leaves=63, learning_rate=0.05, min_child_samples=10)
+    p = m.get_params()
+    assert p["num_leaves"] == 63
+    assert p["learning_rate"] == 0.05
+    m.set_params(num_leaves=7, reg_alpha=0.5)
+    assert m.get_params()["num_leaves"] == 7
+    assert m.get_params()["reg_alpha"] == 0.5
+    # sklearn clone-compat: constructing from get_params round-trips
+    m2 = LGBMClassifier(**m.get_params())
+    assert m2.get_params()["num_leaves"] == 7
+
+
+def test_pickle_roundtrip(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    m = LGBMClassifier(n_estimators=10, num_leaves=15)
+    m.fit(Xtr, ytr)
+    pred_before = m.predict_proba(Xte)
+    blob = pickle.dumps(m)
+    m2 = pickle.loads(blob)
+    pred_after = m2.predict_proba(Xte)
+    np.testing.assert_allclose(pred_before, pred_after, rtol=1e-6)
+
+
+def test_class_weight(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    m = LGBMClassifier(n_estimators=15, num_leaves=15, class_weight="balanced")
+    m.fit(Xtr, ytr)
+    assert m.score(Xte, yte) > 0.75
+
+
+def test_predict_shape_mismatch(binary_data):
+    Xtr, ytr, Xte, _ = binary_data
+    m = LGBMClassifier(n_estimators=5, num_leaves=7)
+    m.fit(Xtr, ytr)
+    with pytest.raises(lgb.LightGBMError):
+        m.predict(Xte[:, :3])
+
+
+def test_not_fitted_raises(binary_data):
+    m = LGBMClassifier()
+    with pytest.raises(lgb.LightGBMError):
+        m.predict(binary_data[0])
+    with pytest.raises(lgb.LightGBMError):
+        _ = m.feature_importances_
