@@ -307,7 +307,7 @@ class Booster:
         if pred_leaf:
             return self._gbdt.predict_leaf_index(data, num_iteration)
         if pred_contrib:
-            raise LightGBMError("pred_contrib (TreeSHAP) not yet implemented")
+            return self._gbdt.predict_contrib(data, num_iteration, start_iteration)
         return self._gbdt.predict(data, num_iteration, start_iteration, raw_score)
 
     # ------------------------------------------------------------------

@@ -322,6 +322,30 @@ class GBDT:
             return np.asarray(self.objective.convert_output(raw.T)).T
         return np.asarray(self.objective.convert_output(raw))
 
+    def predict_contrib(self, X: np.ndarray, num_iteration: int = -1,
+                        start_iteration: int = 0) -> np.ndarray:
+        """TreeSHAP feature contributions (reference ``GBDT::PredictContrib``
+        via ``Tree::TreeSHAP``, ``tree.cpp:887``): per row, per class,
+        ``[num_features + 1]`` with the bias (expected value) last."""
+        from ..ops.shap import tree_shap, expected_value
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        n, F = X.shape
+        K = self.num_tree_per_iteration
+        n_iters = len(self.models) // K
+        if num_iteration is not None and num_iteration > 0:
+            n_iters = min(n_iters, num_iteration)
+        out = np.zeros((n, K, F + 1))
+        for i in range(start_iteration, start_iteration + n_iters):
+            for k in range(K):
+                ti = i * K + k
+                if ti < len(self.models):
+                    t = self.models[ti]
+                    out[:, k, :F] += tree_shap(t, X)
+                    out[:, k, F] += expected_value(t)
+        return out[:, 0, :] if K == 1 else out.reshape(n, K * (F + 1))
+
     def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         X = np.asarray(X, dtype=np.float64)
         if X.ndim == 1:

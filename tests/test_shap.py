@@ -1,0 +1,104 @@
+"""TreeSHAP (pred_contrib) tests.
+
+Checks the two defining properties: (1) local accuracy — contributions sum
+to the raw prediction; (2) exact agreement with brute-force path-dependent
+Shapley values on a small tree."""
+import itertools
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def test_contrib_sums_to_raw(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                    train, num_boost_round=10)
+    Xs = Xte[:50]
+    contrib = bst.predict(Xs, pred_contrib=True)
+    assert contrib.shape == (50, Xtr.shape[1] + 1)
+    raw = bst.predict(Xs, raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5, atol=1e-6)
+
+
+def test_contrib_multiclass(multiclass_data):
+    Xtr, ytr, Xte, _ = multiclass_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "multiclass", "num_class": 4,
+                     "num_leaves": 7, "verbose": -1}, train, num_boost_round=5)
+    Xs = Xte[:20]
+    F = Xtr.shape[1]
+    contrib = bst.predict(Xs, pred_contrib=True)
+    assert contrib.shape == (20, 4 * (F + 1))
+    raw = bst.predict(Xs, raw_score=True)          # [n, 4]
+    per_class = contrib.reshape(20, 4, F + 1).sum(axis=2)
+    np.testing.assert_allclose(per_class, raw, rtol=1e-5, atol=1e-6)
+
+
+def _brute_force_shap(tree, x, n_features):
+    """Exact path-dependent Shapley values by enumerating all feature
+    subsets: E[f | S] computed by the conditional-expectation tree walk
+    (the same conditioning TreeSHAP uses)."""
+    def cond_exp(node, S):
+        # expectation of tree output given features in S fixed at x
+        if node < 0:
+            return float(tree.leaf_value[~node])
+        f = int(tree.split_feature[node])
+        left, right = int(tree.left_child[node]), int(tree.right_child[node])
+        def cnt(i):
+            return float(tree.leaf_count[~i] if i < 0 else tree.internal_count[i])
+        if f in S:
+            goes_left = bool(tree._decide(node, np.array([x[f]]))[0])
+            return cond_exp(left if goes_left else right, S)
+        w = cnt(node)
+        return (cnt(left) / w) * cond_exp(left, S) + \
+               (cnt(right) / w) * cond_exp(right, S)
+
+    from math import factorial
+    phi = np.zeros(n_features)
+    feats = list(range(n_features))
+    for i in feats:
+        others = [f for f in feats if f != i]
+        for r in range(len(others) + 1):
+            for S in itertools.combinations(others, r):
+                S = set(S)
+                weight = (factorial(len(S)) * factorial(n_features - len(S) - 1)
+                          / factorial(n_features))
+                phi[i] += weight * (cond_exp(0, S | {i}) - cond_exp(0, S))
+    return phi
+
+
+def test_treeshap_matches_bruteforce():
+    rng = np.random.default_rng(3)
+    n, F = 400, 4
+    X = rng.normal(size=(n, F))
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(float)
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 8,
+                     "min_data_in_leaf": 20, "verbose": -1},
+                    train, num_boost_round=3)
+    from lightgbm_tpu.ops.shap import tree_shap
+    for t in bst._gbdt.models:
+        if t.num_leaves <= 1:
+            continue
+        Xs = X[:5]
+        got = tree_shap(t, Xs)
+        for r in range(5):
+            want = _brute_force_shap(t, Xs[r], F)
+            np.testing.assert_allclose(got[r], want, rtol=1e-6, atol=1e-8)
+
+
+def test_expected_value_is_weighted_leaf_mean():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(500, 5))
+    y = X[:, 0] * 2 + rng.normal(size=500) * 0.1
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbose": -1}, train, num_boost_round=2)
+    from lightgbm_tpu.ops.shap import expected_value
+    t = bst._gbdt.models[1]
+    ev = expected_value(t)
+    w = t.leaf_count / t.leaf_count.sum()
+    np.testing.assert_allclose(ev, float(np.sum(w * t.leaf_value)), rtol=1e-9)
