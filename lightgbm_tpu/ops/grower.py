@@ -28,7 +28,36 @@ import jax.numpy as jnp
 
 from .histogram import build_histogram
 from .split import (NEG_INF, SplitParams, SplitResult, find_best_split,
-                    leaf_output)
+                    leaf_output, per_feature_gains)
+
+
+def _reduce_split_global(s: SplitResult, axis_name: str) -> SplitResult:
+    """Allreduce-max of a per-shard best split: the TPU analog of the
+    reference's ``SyncUpGlobalBestSplit`` serialized-SplitInfo allreduce
+    (``parallel_tree_learner.h:191-214``) — a pmax on the gain picks the
+    winner, ties break to the lowest shard, and the winner's scalar payload
+    is broadcast by masked psum (no byte packing needed)."""
+    gain_max = jax.lax.pmax(s.gain, axis_name)
+    dev = jax.lax.axis_index(axis_name)
+    n_dev = jax.lax.psum(1, axis_name)
+    claim = jnp.where(s.gain >= gain_max, dev, n_dev)
+    winner = jax.lax.pmin(claim, axis_name)
+    mine = (dev == winner)
+
+    def bc(x):
+        xf = x.astype(jnp.float32)
+        out = jax.lax.psum(jnp.where(mine, xf, jnp.zeros_like(xf)), axis_name)
+        return out.astype(x.dtype) if x.dtype != jnp.float32 else out
+
+    return SplitResult(
+        gain=gain_max,
+        feature=bc(s.feature), threshold=bc(s.threshold),
+        default_left=bc(s.default_left.astype(jnp.int32)).astype(bool),
+        left_sum_g=bc(s.left_sum_g), left_sum_h=bc(s.left_sum_h),
+        left_count=bc(s.left_count),
+        right_sum_g=bc(s.right_sum_g), right_sum_h=bc(s.right_sum_h),
+        right_count=bc(s.right_count),
+        left_output=bc(s.left_output), right_output=bc(s.right_output))
 
 
 class GrowerConfig(NamedTuple):
@@ -44,6 +73,16 @@ class GrowerConfig(NamedTuple):
     # reference's histogram ReduceScatter + global-sum collectives
     # (data_parallel_tree_learner.cpp:155-173, network.h:168) become a psum
     axis_name: "str | None" = None
+    # parallel strategy over axis_name (SURVEY.md §2.9):
+    #   'data'    — rows sharded; full-histogram psum (DataParallelTreeLearner)
+    #   'feature' — features sharded, rows replicated; split search sharded,
+    #               winning SplitInfo reduced (FeatureParallelTreeLearner)
+    #   'voting'  — rows sharded; local top-k vote elects 2k features, only
+    #               their histograms are reduced (VotingParallelTreeLearner)
+    # None with axis_name set defaults to 'data'.
+    parallel_mode: "str | None" = None
+    top_k: int = 20               # voting: local proposals per leaf
+    num_shards: int = 1           # static axis size (gates scaling in voting)
 
 
 class TreeArrays(NamedTuple):
@@ -108,13 +147,36 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     L = cfg.num_leaves
     B = cfg.max_bin
     p = cfg.split
+    axis = cfg.axis_name
+    mode = cfg.parallel_mode or ("data" if axis is not None else None)
+
+    # --- feature-parallel bookkeeping: features sharded over the axis -------
+    # metadata arrays arrive FULL-width [F_total]; the histogram axis is the
+    # local shard.  Local slices feed the split search, full arrays feed the
+    # partition step (which sees the globally-reduced winning feature id).
+    if mode == "feature":
+        dev = jax.lax.axis_index(axis)
+        f_start = dev * f
+
+        def lslice(a):
+            return jax.lax.dynamic_slice_in_dim(a, f_start, f)
+        num_bins_l = lslice(num_bins)
+        default_bins_l = lslice(default_bins)
+        nan_bins_l = lslice(nan_bins)
+        is_cat_l = lslice(is_categorical)
+        mono_l = lslice(monotone)
+        f_full = feature_mask.shape[0]
+    else:
+        num_bins_l, default_bins_l, nan_bins_l = num_bins, default_bins, nan_bins
+        is_cat_l, mono_l = is_categorical, monotone
+        f_full = f
 
     def hist_of(mask):
         h = build_histogram(bins, grad, hess, mask, B,
                             method=cfg.hist_method,
                             chunk_rows=cfg.hist_chunk_rows)
-        if cfg.axis_name is not None:
-            h = jax.lax.psum(h, cfg.axis_name)
+        if mode == "data":
+            h = jax.lax.psum(h, axis)
         return h
 
     def node_feature_mask(step):
@@ -122,19 +184,68 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             return feature_mask
         k = jax.random.fold_in(key, step)
         frac = cfg.feature_fraction_bynode
-        n_take = max(1, int(frac * f + 0.5))
-        u = jax.random.uniform(k, (f,))
+        n_take = max(1, int(frac * f_full + 0.5))
+        u = jax.random.uniform(k, (f_full,))
         u = jnp.where(feature_mask > 0, u, -jnp.inf)
         thresh = jax.lax.top_k(u, n_take)[0][-1]
         return jnp.where(u >= thresh, feature_mask, 0.0)
+
+    def find(hist, sum_g, sum_h, count, fmask, parent_output=0.0,
+             lo=NEG_INF, hi=-NEG_INF):
+        """Mode-dispatched best-split search (the analog of the reference's
+        learner-specific FindBestSplitsFromHistograms overrides)."""
+        if mode == "feature":
+            fmask_l = jax.lax.dynamic_slice_in_dim(fmask, f_start, f)
+            s = find_best_split(hist, num_bins_l, default_bins_l, nan_bins_l,
+                                is_cat_l, mono_l, sum_g, sum_h, count, p,
+                                fmask_l, parent_output, lo, hi)
+            # local winner carries a shard-local feature id; globalize and
+            # allreduce-max the packed SplitInfo (parallel_tree_learner.h:191)
+            s = s._replace(feature=s.feature + f_start)
+            return _reduce_split_global(s, axis)
+        if mode == "voting":
+            return _find_voting(hist, sum_g, sum_h, count, fmask,
+                                parent_output, lo, hi)
+        return find_best_split(hist, num_bins_l, default_bins_l, nan_bins_l,
+                               is_cat_l, mono_l, sum_g, sum_h, count, p,
+                               fmask, parent_output, lo, hi)
+
+    def _find_voting(hist, sum_g, sum_h, count, fmask, parent_output, lo, hi):
+        """Local top-k proposal → global vote → reduce only elected
+        histograms (voting_parallel_tree_learner.cpp:151-345)."""
+        # local gains with min-data/hessian gates scaled to the shard
+        # (reference scales by 1/num_machines, :61-63)
+        ns = max(1, cfg.num_shards)
+        p_loc = p._replace(
+            min_data_in_leaf=max(1, p.min_data_in_leaf // ns),
+            min_sum_hessian_in_leaf=p.min_sum_hessian_in_leaf / ns)
+        fg = per_feature_gains(hist, num_bins_l, nan_bins_l, is_cat_l, mono_l,
+                               sum_g / ns, sum_h / ns, count / ns, p_loc,
+                               fmask, parent_output, lo, hi)
+        k = min(cfg.top_k, f_full)
+        topv, topi = jax.lax.top_k(fg, k)
+        votes = jnp.zeros(f_full, jnp.float32).at[topi].add(
+            jnp.where(topv > NEG_INF / 2, 1.0, 0.0))
+        votes = jax.lax.psum(votes, axis)
+        # elect 2k features (GlobalVoting); deterministic tie-break by index
+        score = votes * (f_full + 1.0) - jnp.arange(f_full, dtype=jnp.float32)
+        k2 = min(2 * k, f_full)
+        _, elected = jax.lax.top_k(score, k2)                # [2k], replicated
+        h_glob = jax.lax.psum(hist[elected], axis)           # [2k, B, 3]
+        hist_e = jnp.zeros_like(hist).at[elected].set(h_glob)
+        emask = jnp.zeros(f_full, jnp.float32).at[elected].set(1.0)
+        emask = jnp.where(fmask > 0, emask, 0.0)
+        return find_best_split(hist_e, num_bins_l, default_bins_l, nan_bins_l,
+                               is_cat_l, mono_l, sum_g, sum_h, count, p,
+                               emask, parent_output, lo, hi)
 
     # ---- degenerate case: no usable features -> single-leaf tree -----------
     if f == 0:
         cnt = jnp.sum(row_weight)
         wgt = jnp.sum(hess * row_weight)
-        if cfg.axis_name is not None:
-            cnt = jax.lax.psum(cnt, cfg.axis_name)
-            wgt = jax.lax.psum(wgt, cfg.axis_name)
+        if mode in ("data", "voting"):
+            cnt = jax.lax.psum(cnt, axis)
+            wgt = jax.lax.psum(wgt, axis)
         empty = TreeArrays(
             split_feature=jnp.full(L - 1, -1, jnp.int32),
             threshold=jnp.zeros(L - 1, jnp.int32),
@@ -155,13 +266,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     root_hist = hist_of(row_weight)
     tot = jnp.stack([jnp.sum(grad * row_weight), jnp.sum(hess * row_weight),
                      jnp.sum(row_weight)])
-    if cfg.axis_name is not None:
+    if mode in ("data", "voting"):
         # root grad/hess sums are global (reference Allreduce,
-        # data_parallel_tree_learner.cpp:126-152)
-        tot = jax.lax.psum(tot, cfg.axis_name)
-    root_split = find_best_split(
-        root_hist, num_bins, default_bins, nan_bins, is_categorical, monotone,
-        tot[0], tot[1], tot[2], p, node_feature_mask(0))
+        # data_parallel_tree_learner.cpp:126-152); feature-parallel replicates
+        # rows so local sums are already global
+        tot = jax.lax.psum(tot, axis)
+    root_split = find(root_hist, tot[0], tot[1], tot[2], node_feature_mask(0))
 
     hist_store = jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist)
     best = _BestSplits.empty(L).set_leaf(0, root_split)
@@ -220,11 +330,24 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             st_ncount = st["node_count"].at[j].set(st["leaf_count"][leaf])
 
             # --- partition rows of this leaf ---
-            col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+            if mode == "feature":
+                # only the shard owning the winning feature can decide; it
+                # broadcasts the decision (the reference avoids this because
+                # every rank holds every column — here columns are sharded,
+                # so one [n] psum replaces replicated column storage)
+                local_ix = jnp.clip(feat - f_start, 0, f - 1)
+                owns = (feat >= f_start) & (feat < f_start + f)
+                col = jnp.take(bins, local_ix, axis=1).astype(jnp.int32)
+            else:
+                col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
             is_miss = (col == nan_bins[feat]) & (nan_bins[feat] >= 0)
             goes_left = jnp.where(
                 f_is_cat, col == thr,
                 jnp.where(is_miss, dleft, col <= thr))
+            if mode == "feature":
+                goes_left = jax.lax.psum(
+                    jnp.where(owns, goes_left.astype(jnp.float32), 0.0),
+                    axis) > 0.5
             in_leaf = st["node_assign"] == leaf
             node_assign = jnp.where(in_leaf & ~goes_left, new_id, st["node_assign"])
 
@@ -266,9 +389,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             depth_ok = (cfg.max_depth <= 0) | (depth < cfg.max_depth)
 
             def child_best(hist_c, g, h, c, lo_, hi_):
-                s = find_best_split(hist_c, num_bins, default_bins, nan_bins,
-                                    is_categorical, monotone, g, h, c, p, fmask,
-                                    0.0, lo_, hi_)
+                s = find(hist_c, g, h, c, fmask, 0.0, lo_, hi_)
                 return s._replace(gain=jnp.where(depth_ok, s.gain, NEG_INF))
 
             sl = child_best(lhist, b.lg[leaf], b.lh[leaf], b.lc[leaf], l_lo, l_hi)

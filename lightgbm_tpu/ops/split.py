@@ -95,21 +95,12 @@ def leaf_gain(sum_g, sum_h, p: SplitParams, parent_output=0.0, count=None,
     return g1 * g1 / (sum_h + p.lambda_l2 + 1e-35)
 
 
-def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Array,
-                    nan_bins: jax.Array, is_categorical: jax.Array,
-                    monotone: jax.Array, sum_g, sum_h, count,
-                    p: SplitParams, feature_mask: jax.Array,
-                    parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF
-                    ) -> SplitResult:
-    """Find the best split of a leaf given its histogram.
+def _split_gain_matrix(hist, num_bins, nan_bins, is_categorical, monotone,
+                       total, p: SplitParams, feature_mask,
+                       parent_output, output_lo, output_hi):
+    """Candidate gains over all (feature, threshold) pairs.
 
-    Args:
-      hist: ``[F, B, 3]`` (grad, hess, count) histogram of the leaf.
-      num_bins/default_bins/nan_bins/is_categorical/monotone: ``[F]`` feature
-        metadata from ``Dataset.device_data``.
-      sum_g/sum_h/count: leaf totals (scalars).
-      feature_mask: ``[F]`` f32/bool — column sampling / interaction constraints.
-      output_lo/output_hi: monotone bounds for this leaf's subtree.
+    Returns (gain_fb [F, B], use_left [F, B], cum [F, B, 3], miss [F, 3]).
     """
     f, b, _ = hist.shape
     bin_ids = jnp.arange(b, dtype=jnp.int32)[None, :]                  # [1, B]
@@ -125,7 +116,6 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
     swept = jnp.where(miss_sel[:, :, None], 0.0, hist)                 # [F, B, 3]
 
     cum = jnp.cumsum(swept, axis=1)                                    # [F, B, 3]
-    total = jnp.stack([sum_g, sum_h, count]).astype(jnp.float32)       # [3]
 
     # threshold t means: bins <= t go left (t in [0, num_bin-2])
     valid_t = bin_ids < (num_bins[:, None] - 1 - (has_miss[:, None]))  # [F, B]
@@ -151,6 +141,44 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
     is_cat = is_categorical[:, None]
     gain_fb = jnp.where(is_cat, cat_gain, num_gain)                    # [F, B]
     gain_fb = jnp.where(feature_mask[:, None] > 0, gain_fb, NEG_INF)
+    return gain_fb, use_left, cum, miss
+
+
+def per_feature_gains(hist, num_bins, nan_bins, is_categorical, monotone,
+                      sum_g, sum_h, count, p: SplitParams, feature_mask,
+                      parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF
+                      ) -> jax.Array:
+    """Best candidate gain per feature — ``[F]``.  Used by the voting-parallel
+    learner's local top-k proposal (reference ``VotingParallelTreeLearner``,
+    ``voting_parallel_tree_learner.cpp:151``)."""
+    total = jnp.stack([sum_g, sum_h, count]).astype(jnp.float32)
+    gain_fb, _, _, _ = _split_gain_matrix(
+        hist, num_bins, nan_bins, is_categorical, monotone, total, p,
+        feature_mask, parent_output, output_lo, output_hi)
+    return jnp.max(gain_fb, axis=1)
+
+
+def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Array,
+                    nan_bins: jax.Array, is_categorical: jax.Array,
+                    monotone: jax.Array, sum_g, sum_h, count,
+                    p: SplitParams, feature_mask: jax.Array,
+                    parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF
+                    ) -> SplitResult:
+    """Find the best split of a leaf given its histogram.
+
+    Args:
+      hist: ``[F, B, 3]`` (grad, hess, count) histogram of the leaf.
+      num_bins/default_bins/nan_bins/is_categorical/monotone: ``[F]`` feature
+        metadata from ``Dataset.device_data``.
+      sum_g/sum_h/count: leaf totals (scalars).
+      feature_mask: ``[F]`` f32/bool — column sampling / interaction constraints.
+      output_lo/output_hi: monotone bounds for this leaf's subtree.
+    """
+    f, b, _ = hist.shape
+    total = jnp.stack([sum_g, sum_h, count]).astype(jnp.float32)       # [3]
+    gain_fb, use_left, cum, miss = _split_gain_matrix(
+        hist, num_bins, nan_bins, is_categorical, monotone, total, p,
+        feature_mask, parent_output, output_lo, output_hi)
 
     # --- argmax over (feature, threshold) ------------------------------------
     flat = gain_fb.reshape(-1)

@@ -18,6 +18,9 @@ dask.py``) is ``jax.distributed.initialize`` + the standard TPU pod runtime.
 """
 from .mesh import default_mesh, init_distributed
 from .data_parallel import make_dp_train_step, pad_rows_to_multiple, shard_rows
+from .feature_parallel import make_fp_train_step, pad_features_to_multiple
+from .voting_parallel import make_voting_train_step
 
 __all__ = ["default_mesh", "init_distributed", "make_dp_train_step",
-           "pad_rows_to_multiple", "shard_rows"]
+           "make_fp_train_step", "make_voting_train_step",
+           "pad_rows_to_multiple", "pad_features_to_multiple", "shard_rows"]

@@ -119,6 +119,93 @@ def test_dp_multiple_iterations_improve_loss():
     assert l1 < l0 - 0.05, (l0, l1)
 
 
+def test_fp_tree_matches_single_device():
+    """Feature-parallel growth (features sharded, rows replicated) produces
+    the identical tree (FeatureParallelTreeLearner semantics: same data,
+    sharded search, allreduce-max of the SplitInfo)."""
+    from lightgbm_tpu.parallel import make_fp_train_step
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    n, f, max_bin = 512, 8, 32            # f divisible by 8
+    bins_np, label_np = _data(n, f, max_bin, seed=11)
+    meta = _meta(f, max_bin)
+    key = jax.random.key(7)
+
+    g, h = _grad_fn(jnp.zeros(n), jnp.asarray(label_np))
+    tree_ref, assign_ref = jax.jit(
+        lambda b, g, h: grow_tree(b, g, h, jnp.ones(n), jnp.ones(f),
+                                  meta["num_bins"], meta["default_bins"],
+                                  meta["nan_bins"], meta["is_categorical"],
+                                  meta["monotone"], key, _cfg()))(
+        jnp.asarray(bins_np), g, h)
+
+    mesh = default_mesh(8, axis_name="feature")
+    step = make_fp_train_step(_cfg(), meta, _grad_fn, learning_rate=0.1,
+                              mesh=mesh)
+    sh = NamedSharding(mesh, P(None, "feature"))
+    bins = jax.device_put(jnp.asarray(bins_np), sh)
+    new_score, tree_fp = step(bins, jnp.asarray(label_np),
+                              jnp.zeros(n, jnp.float32),
+                              jnp.ones(n, jnp.float32), jnp.ones(f), key)
+
+    assert int(tree_fp.num_leaves) == int(tree_ref.num_leaves)
+    np.testing.assert_array_equal(np.asarray(tree_fp.split_feature),
+                                  np.asarray(tree_ref.split_feature))
+    np.testing.assert_array_equal(np.asarray(tree_fp.threshold),
+                                  np.asarray(tree_ref.threshold))
+    np.testing.assert_allclose(np.asarray(tree_fp.leaf_value),
+                               np.asarray(tree_ref.leaf_value),
+                               rtol=1e-4, atol=1e-5)
+    expected = np.asarray(tree_ref.leaf_value)[np.asarray(assign_ref)] * 0.1
+    np.testing.assert_allclose(np.asarray(new_score), expected,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_voting_parallel_learns():
+    """Voting-parallel training converges; with top_k >= F the vote elects
+    every feature, so the tree matches single-device exactly."""
+    from lightgbm_tpu.parallel import make_voting_train_step
+    n, f, max_bin = 1024, 6, 32
+    bins_np, label_np = _data(n, f, max_bin, seed=13)
+    meta = _meta(f, max_bin)
+    mesh = default_mesh(8)
+    key = jax.random.key(2)
+
+    # exactness check when every feature is elected
+    g, h = _grad_fn(jnp.zeros(n), jnp.asarray(label_np))
+    tree_ref, _ = jax.jit(
+        lambda b, g, h: grow_tree(b, g, h, jnp.ones(n), jnp.ones(f),
+                                  meta["num_bins"], meta["default_bins"],
+                                  meta["nan_bins"], meta["is_categorical"],
+                                  meta["monotone"], key, _cfg()))(
+        jnp.asarray(bins_np), g, h)
+    step_all = make_voting_train_step(_cfg(), meta, _grad_fn, 0.2, mesh,
+                                      top_k=f)
+    sh = shard_rows(mesh)
+    bins = jax.device_put(jnp.asarray(bins_np), sh)
+    label = jax.device_put(jnp.asarray(label_np), sh)
+    score = jax.device_put(jnp.zeros(n, jnp.float32), sh)
+    rw = jax.device_put(jnp.ones(n, jnp.float32), sh)
+    _, tree_v = step_all(bins, label, score, rw, jnp.ones(f), key)
+    np.testing.assert_array_equal(np.asarray(tree_v.split_feature),
+                                  np.asarray(tree_ref.split_feature))
+    np.testing.assert_array_equal(np.asarray(tree_v.threshold),
+                                  np.asarray(tree_ref.threshold))
+
+    # restricted vote (top_k=2 -> 4 elected of 6) still converges
+    step = make_voting_train_step(_cfg(), meta, _grad_fn, 0.2, mesh, top_k=2)
+
+    def logloss(s):
+        p = 1 / (1 + np.exp(-np.asarray(s)))
+        y = label_np
+        return -np.mean(y * np.log(p + 1e-9) + (1 - y) * np.log(1 - p + 1e-9))
+
+    l0 = logloss(score)
+    for i in range(10):
+        score, _ = step(bins, label, score, rw, jnp.ones(f), jax.random.key(i))
+    l1 = logloss(score)
+    assert l1 < l0 - 0.05, (l0, l1)
+
+
 def test_graft_entry_dryrun():
     import importlib.util, pathlib
     spec = importlib.util.spec_from_file_location(
