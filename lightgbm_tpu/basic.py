@@ -226,6 +226,12 @@ class Booster:
         self._gbdt.rollback_one_iter()
         return self
 
+    def refit(self, data, label, decay_rate: float = 0.9) -> "Booster":
+        """Refit existing tree structures on new data (reference
+        ``Booster.refit``, ``basic.py``; ``GBDT::RefitTree``)."""
+        self._gbdt.refit(np.asarray(data, np.float64), label, decay_rate)
+        return self
+
     # -- pickling: serialize through the model string, like the reference
     # Booster.__getstate__ (basic.py) -----------------------------------
     def __getstate__(self):

@@ -37,9 +37,6 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     if fobj is not None:
         params["objective"] = "none"
 
-    if init_model is not None:
-        raise LightGBMError("init_model continued training lands with model IO round-trip work")
-
     booster = Booster(params=params, train_set=train_set)
     if valid_sets:
         valid_names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
@@ -49,6 +46,11 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                 booster.name_valid_sets.append("training")
                 continue
             booster.add_valid(vs, name)
+
+    if init_model is not None:
+        prev = (Booster(model_file=init_model) if isinstance(init_model, str)
+                else init_model)
+        booster._gbdt.continue_from(prev._gbdt)
 
     cbs = list(callbacks or [])
     if verbose_eval is True:

@@ -360,6 +360,90 @@ class GBDT:
         return out
 
     # ------------------------------------------------------------------
+    def continue_from(self, prev: "GBDT") -> None:
+        """Continued training from an existing model (reference CLI
+        ``input_model`` / Python ``init_model``: ``boosting.cpp:35-60``,
+        ``engine.py:15``): adopt the previous ensemble and warm up the
+        cached train/valid scores with its predictions over the binned data."""
+        import copy
+        check(prev.num_tree_per_iteration == self.num_tree_per_iteration,
+              "init_model has a different number of tree per iteration")
+        self.models = [copy.deepcopy(t) for t in prev.models]
+        self._tree_weights = list(prev._tree_weights) or [1.0] * len(self.models)
+        self._device_trees = []
+        K = self.num_tree_per_iteration
+        self.iter_ = len(self.models) // K
+
+        def warm(dd, score):
+            bins_np = np.asarray(dd.bins)
+            nan_np = np.asarray(dd.nan_bins)
+            s = np.array(score, np.float64)
+            for i, t in enumerate(self.models):
+                s[i % K] = s[i % K] + t.predict_binned(bins_np, nan_np)
+            return jnp.asarray(s.astype(np.float32))
+
+        # the first tree of the previous model already carries its bias;
+        # drop this model's own boost-from-average init
+        self._train_score = warm(self._dd, jnp.zeros_like(self._train_score))
+        for vi, vset in enumerate(self.valid_sets):
+            self._valid_scores[vi] = warm(vset.device_data(),
+                                          jnp.zeros_like(self._valid_scores[vi]))
+
+    # ------------------------------------------------------------------
+    def refit(self, X: np.ndarray, y: np.ndarray, decay_rate: float = 0.9) -> None:
+        """Refit the existing tree structures on new data (reference
+        ``GBDT::RefitTree`` (``gbdt.cpp:285``) + ``FitByExistingTree``
+        (``serial_tree_learner.cpp:211-250``)): per iteration, gradients at
+        the progressive score are re-aggregated per leaf and
+        ``new = output*shrinkage``, ``leaf = decay*old + (1-decay)*new``."""
+        from ..objective import create_objective
+        from ..io.dataset import Metadata
+        cfg = self.config
+        X = np.asarray(X, np.float64)
+        n = X.shape[0]
+        obj = self.objective
+        if obj is None:
+            obj = create_objective(cfg)
+        if obj is None:
+            raise LightGBMError("cannot refit without an objective")
+        md = Metadata(n)
+        md.set_field("label", y)
+        obj.init(md, n)
+        K = self.num_tree_per_iteration
+        n_iters = len(self.models) // K
+        label_dev = jnp.asarray(md.label)
+        score = np.zeros((K, n), np.float32)
+        leaf_idx = [t.predict_leaf_index(X) for t in self.models]
+        lam1, lam2, mds = cfg.lambda_l1, cfg.lambda_l2, cfg.max_delta_step
+
+        def out_of(sg, sh):
+            thr = np.sign(sg) * np.maximum(np.abs(sg) - lam1, 0.0)
+            o = -thr / (sh + lam2 + 1e-35)
+            if mds > 0:
+                o = np.clip(o, -mds, mds)
+            return o
+
+        for it in range(n_iters):
+            sc = jnp.asarray(score)
+            if K > 1:
+                g, h = obj.get_gradients_multi(sc, label_dev, None)
+            else:
+                g0, h0 = obj.get_gradients(sc[0], label_dev, None)
+                g, h = g0[None, :], h0[None, :]
+            g, h = np.asarray(g, np.float64), np.asarray(h, np.float64)
+            for k in range(K):
+                t = self.models[it * K + k]
+                lp = leaf_idx[it * K + k]
+                nl = t.num_leaves
+                sg = np.bincount(lp, weights=g[k], minlength=nl)[:nl]
+                sh = np.bincount(lp, weights=h[k], minlength=nl)[:nl] + 1e-15
+                new_out = out_of(sg, sh) * t.shrinkage
+                t.leaf_value = (decay_rate * t.leaf_value
+                                + (1.0 - decay_rate) * new_out)
+                score[k] += t.leaf_value[lp].astype(np.float32)
+        self._device_trees = []            # host trees changed; drop caches
+
+    # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
         """Reference ``GBDT::RollbackOneIter`` (``gbdt.cpp:454``): undo the
         last iteration's trees and restore cached scores (one-step history)."""

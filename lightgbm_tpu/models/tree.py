@@ -163,6 +163,41 @@ class Tree:
             active[rows[done]] = False
         return out
 
+    def predict_binned(self, bins: np.ndarray, nan_bins: np.ndarray) -> np.ndarray:
+        """Batch prediction over BINNED columns (inner feature space), using
+        the grower's decision convention (``ops/grower.py`` partition step).
+        Used for continued-training score warm-up where only the binned
+        matrix is resident."""
+        n = bins.shape[0]
+        if self.num_leaves <= 1:
+            return np.full(n, self.leaf_value[0] if len(self.leaf_value) else 0.0)
+        out = np.zeros(n, np.float64)
+        node = np.zeros(n, np.int64)
+        active = np.ones(n, bool)
+        idx = np.arange(n)
+        while active.any():
+            cur = node[active]
+            rows = idx[active]
+            goes_left = np.zeros(len(rows), bool)
+            for j in np.unique(cur):
+                sel = cur == j
+                fi = int(self.split_feature_inner[j])
+                col = bins[rows[sel], fi].astype(np.int64)
+                thr = int(self.threshold_bin[j])
+                if self.is_categorical_split(j):
+                    goes_left[sel] = col == thr
+                else:
+                    nb = int(nan_bins[fi])
+                    is_miss = (col == nb) & (nb >= 0)
+                    goes_left[sel] = np.where(is_miss, self.default_left(j),
+                                              col <= thr)
+            nxt = np.where(goes_left, self.left_child[cur], self.right_child[cur])
+            node[active] = nxt
+            done = nxt < 0
+            out[rows[done]] = self.leaf_value[~nxt[done]]
+            active[rows[done]] = False
+        return out
+
     def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
         n = X.shape[0]
         if self.num_leaves <= 1:

@@ -1,0 +1,155 @@
+"""CLI application, convert_model codegen, refit, continued training —
+mirrors the reference's CLI end-to-end + test_consistency.py (SURVEY.md §4)."""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _write_csv(path, X, y):
+    data = np.column_stack([y, X])
+    np.savetxt(path, data, delimiter="\t", fmt="%.8g")
+
+
+@pytest.fixture(scope="module")
+def cli_files(tmp_path_factory, binary_data):
+    d = tmp_path_factory.mktemp("cli")
+    Xtr, ytr, Xte, yte = binary_data
+    _write_csv(d / "binary.train", Xtr, ytr)
+    _write_csv(d / "binary.test", Xte, yte)
+    conf = d / "train.conf"
+    conf.write_text(
+        "task = train\n"
+        "boosting_type = gbdt\n"
+        "objective = binary\n"
+        "metric = binary_logloss,auc\n"
+        "metric_freq = 1\n"
+        "max_bin = 255\n"
+        f"data = {d / 'binary.train'}\n"
+        f"valid_data = {d / 'binary.test'}\n"
+        "num_trees = 15\n"
+        "learning_rate = 0.1\n"
+        "num_leaves = 15\n"
+        "tree_learner = serial\n"
+        "min_data_in_leaf = 20\n"
+        f"output_model = {d / 'model.txt'}\n"
+        "verbose = -1\n"
+    )
+    return d
+
+
+def test_cli_train_and_predict(cli_files, binary_data):
+    from lightgbm_tpu.application import main
+    d = cli_files
+    assert main([f"config={d / 'train.conf'}"]) == 0
+    assert (d / "model.txt").exists()
+
+    out = d / "preds.txt"
+    rc = main([f"task=predict", f"data={d / 'binary.test'}",
+               f"input_model={d / 'model.txt'}", f"output_result={out}"])
+    assert rc == 0
+    preds = np.loadtxt(out)
+    Xtr, ytr, Xte, yte = binary_data
+    assert preds.shape == (len(yte),)
+    # CLI-vs-Python parity (test_consistency.py analog)
+    bst = lgb.Booster(model_file=str(d / "model.txt"))
+    py_preds = bst.predict(Xte)
+    np.testing.assert_allclose(preds, py_preds, rtol=1e-6)
+    acc = np.mean((preds > 0.5) == (yte > 0))
+    assert acc > 0.8
+
+
+def test_cli_key_value_overrides(cli_files):
+    from lightgbm_tpu.application import parse_argv
+    p = parse_argv([f"config={cli_files / 'train.conf'}", "num_trees=5",
+                    "learning_rate=0.3"])
+    assert p["num_trees"] == "5"
+    assert p["learning_rate"] == "0.3"
+    assert p["objective"] == "binary"
+
+
+def test_convert_model_compiles_and_matches(cli_files, binary_data, tmp_path):
+    """convert_model → g++ recompile → identical predictions (the reference's
+    tests/cpp_test workflow, .ci/test.sh:62-69)."""
+    from lightgbm_tpu.application import main
+    d = cli_files
+    cpp = tmp_path / "model.cpp"
+    rc = main([f"task=convert_model", f"input_model={d / 'model.txt'}",
+               f"convert_model={cpp}"])
+    assert rc == 0
+    so = tmp_path / "model.so"
+    subprocess.run(["g++", "-O2", "-shared", "-fPIC", str(cpp), "-o", str(so)],
+                   check=True)
+    lib = ctypes.CDLL(str(so))
+    lib.Predict.argtypes = [ctypes.POINTER(ctypes.c_double),
+                            ctypes.POINTER(ctypes.c_double)]
+    Xtr, ytr, Xte, yte = binary_data
+    bst = lgb.Booster(model_file=str(d / "model.txt"))
+    py_preds = bst.predict(Xte[:100])
+    out = (ctypes.c_double * 1)()
+    for i in range(100):
+        row = np.ascontiguousarray(Xte[i], dtype=np.float64)
+        lib.Predict(row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), out)
+        assert abs(out[0] - py_preds[i]) < 1e-6, i
+
+
+def test_refit(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                    train, num_boost_round=10)
+    before = [t.leaf_value.copy() for t in bst._gbdt.models]
+    # refit on the test slice: leaf values move, structure does not
+    feats = [t.split_feature.copy() for t in bst._gbdt.models]
+    bst.refit(Xte, yte, decay_rate=0.5)
+    after = [t.leaf_value for t in bst._gbdt.models]
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+    for f0, t in zip(feats, bst._gbdt.models):
+        np.testing.assert_array_equal(f0, t.split_feature)
+    pred = bst.predict(Xte)
+    acc = np.mean((pred > 0.5) == (yte > 0))
+    assert acc > 0.75
+
+
+def test_cli_refit_task(cli_files):
+    from lightgbm_tpu.application import main
+    d = cli_files
+    rc = main([f"task=refit", f"data={d / 'binary.train'}",
+               f"input_model={d / 'model.txt'}",
+               f"output_model={d / 'model_refit.txt'}"])
+    assert rc == 0
+    assert (d / "model_refit.txt").exists()
+
+
+def test_init_model_continued_training(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    params = {"objective": "binary", "num_leaves": 15, "metric": "binary_logloss",
+              "verbose": -1}
+    train = lgb.Dataset(Xtr, label=ytr)
+    valid = lgb.Dataset(Xte, label=yte, reference=train)
+
+    bst1 = lgb.train(params, train, num_boost_round=10)
+    s1 = bst1.model_to_string()
+
+    # continue for 10 more rounds from the saved model
+    train2 = lgb.Dataset(Xtr, label=ytr)
+    valid2 = lgb.Dataset(Xte, label=yte, reference=train2)
+    evals = {}
+    bst2 = lgb.train(params, train2, num_boost_round=10,
+                     valid_sets=[valid2], valid_names=["v"],
+                     init_model=bst1, evals_result=evals)
+    assert bst2.num_trees() == 20
+    # continued model must beat the 10-round model on logloss
+    def logloss(p, y):
+        p = np.clip(p, 1e-9, 1 - 1e-9)
+        return -np.mean(y * np.log(p) + (1 - y) * np.log(1 - p))
+    l10 = logloss(bst1.predict(Xte), yte)
+    l20 = logloss(bst2.predict(Xte), yte)
+    assert l20 < l10
+    # the recorded first-iteration valid score continues from the old model
+    ll = evals["v"]["binary_logloss"]
+    assert ll[0] < logloss(np.full(len(yte), ytr.mean()), yte)
