@@ -182,6 +182,12 @@ class Dataset:
         n_used = len(self.used_features)
         max_nb = max((self.bin_mappers[f].num_bin for f in self.used_features), default=1)
         dtype = np.uint8 if max_nb <= 256 else np.uint16
+        # native threaded binning (parser.cpp BinValues); numpy fallback
+        from ..native import bin_values
+        native = bin_values(data, self.bin_mappers, self.used_features)
+        if native is not None:
+            self.bins = native.astype(dtype, copy=False)
+            return
         bins = np.empty((self.num_data, n_used), dtype=dtype)
         for i, f in enumerate(self.used_features):
             bins[:, i] = self.bin_mappers[f].value_to_bin(data[:, f]).astype(dtype)

@@ -52,7 +52,12 @@ def _load_delimited(path: str, delim: str, cfg: Config):
         with open(path) as f:
             names = f.readline().strip().split(delim)
         skip = 1
-    data = np.genfromtxt(path, delimiter=delim, skip_header=skip, dtype=np.float64)
+    # native parallel parser (parser.cpp ParseDelimited); numpy fallback
+    from ..native import parse_delimited
+    data = parse_delimited(path, delim, skip)
+    if data is None:
+        data = np.genfromtxt(path, delimiter=delim, skip_header=skip,
+                             dtype=np.float64)
     if data.ndim == 1:
         data = data.reshape(-1, 1)
     # label column (default first; 'name:<x>' or index via label_column)
@@ -72,6 +77,11 @@ def _load_delimited(path: str, delim: str, cfg: Config):
 
 
 def _load_libsvm(path: str):
+    from ..native import parse_libsvm
+    native = parse_libsvm(path)
+    if native is not None:
+        feat, labels = native
+        return feat, labels.astype(np.float32), None
     labels = []
     rows = []
     max_feat = -1
