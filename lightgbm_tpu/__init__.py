@@ -26,7 +26,8 @@ def __getattr__(name):
     if name in ("LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"):
         from . import sklearn as _sk
         return getattr(_sk, name)
-    if name == "plot_importance" or name.startswith("plot_"):
-        from . import plotting as _pl
-        return getattr(_pl, name)
+    if name.startswith("plot_") or name in ("create_tree_digraph", "plotting"):
+        import importlib
+        _pl = importlib.import_module(".plotting", __name__)
+        return _pl if name == "plotting" else getattr(_pl, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

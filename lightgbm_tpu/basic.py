@@ -354,3 +354,87 @@ class Booster:
         if self._gbdt.train_data is not None:
             return list(self._gbdt.train_data.feature_names)
         return list(getattr(self._gbdt, "feature_names_", []))
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of a feature's real split thresholds across the model
+        (reference ``basic.py:3164``)."""
+        if isinstance(feature, str):
+            names = self.feature_name()
+            if feature not in names:
+                raise LightGBMError(f"Unknown feature name {feature!r}")
+            feature = names.index(feature)
+        values = []
+        for t in self._gbdt.models:
+            for j in range(t.num_internal):
+                if (int(t.split_feature[j]) == feature
+                        and not t.is_categorical_split(j)):
+                    values.append(float(t.threshold[j]))
+        values = np.array(values, dtype=np.float64)
+        n_unique = len(np.unique(values))
+        if bins is None or (isinstance(bins, int) and bins > n_unique):
+            bins = max(n_unique, 1)
+        hist, bin_edges = np.histogram(values, bins=bins)
+        if xgboost_style:
+            ret = np.column_stack((bin_edges[1:], hist))
+            ret = ret[ret[:, 1] > 0]
+            try:
+                import pandas as pd
+                return pd.DataFrame(ret, columns=["SplitValue", "Count"])
+            except ImportError:
+                return ret
+        return hist, bin_edges
+
+    def trees_to_dataframe(self):
+        """Flatten the model into one row per node (reference ``basic.py:2245``)."""
+        import pandas as pd
+        if self.num_trees() == 0:
+            raise LightGBMError("There are no trees in this Booster and thus nothing to parse")
+
+        names = self.feature_name()
+
+        def node_rows(tree_index, node, depth, parent):
+            if "split_index" in node:
+                name = f"{tree_index}-S{node['split_index']}"
+                feat_idx = node["split_feature"]
+                feat = names[feat_idx] if feat_idx < len(names) else f"Column_{feat_idx}"
+                left = node["left_child"]
+                right = node["right_child"]
+
+                def child_name(c):
+                    return (f"{tree_index}-S{c['split_index']}" if "split_index" in c
+                            else f"{tree_index}-L{c['leaf_index']}")
+                rows = [{
+                    "tree_index": tree_index, "node_depth": depth,
+                    "node_index": name,
+                    "left_child": child_name(left), "right_child": child_name(right),
+                    "parent_index": parent, "split_feature": feat,
+                    "split_gain": node["split_gain"], "threshold": node["threshold"],
+                    "decision_type": node["decision_type"],
+                    "missing_direction": "left" if node["default_left"] else "right",
+                    "missing_type": node["missing_type"],
+                    "value": node["internal_value"], "weight": None,
+                    "count": node["internal_count"]}]
+                rows += node_rows(tree_index, left, depth + 1, name)
+                rows += node_rows(tree_index, right, depth + 1, name)
+                return rows
+            name = f"{tree_index}-L{node.get('leaf_index', 0)}"
+            return [{
+                "tree_index": tree_index, "node_depth": depth,
+                "node_index": name, "left_child": None, "right_child": None,
+                "parent_index": parent, "split_feature": None,
+                "split_gain": None, "threshold": None, "decision_type": None,
+                "missing_direction": None, "missing_type": None,
+                "value": node["leaf_value"],
+                "weight": node.get("leaf_weight"),
+                "count": node.get("leaf_count", 0)}]
+
+        model = self.dump_model()
+        rows = []
+        for ti in model["tree_info"]:
+            rows += node_rows(ti["tree_index"], ti["tree_structure"], 1, None)
+        return pd.DataFrame(rows, columns=[
+            "tree_index", "node_depth", "node_index", "left_child",
+            "right_child", "parent_index", "split_feature", "split_gain",
+            "threshold", "decision_type", "missing_direction", "missing_type",
+            "value", "weight", "count"])

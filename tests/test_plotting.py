@@ -1,0 +1,103 @@
+"""Plotting + model-introspection artifacts (shape of reference
+tests/python_package_test/test_plotting.py)."""
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained(binary_data):
+    X, y, Xt, yt = binary_data
+    ds = lgb.Dataset(X, label=y)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                    ds, num_boost_round=10,
+                    valid_sets=[ds], valid_names=["train"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    return bst, evals
+
+
+def test_plot_importance(trained):
+    bst, _ = trained
+    ax = lgb.plot_importance(bst)
+    assert ax.get_title() == "Feature importance"
+    assert ax.get_xlabel() == "Feature importance"
+    assert len(ax.patches) >= 1
+    ax2 = lgb.plot_importance(bst, importance_type="gain",
+                              max_num_features=3, title="t", xlabel="x", ylabel="y")
+    assert len(ax2.patches) <= 3
+    assert ax2.get_title() == "t"
+
+
+def test_plot_metric(trained):
+    _, evals = trained
+    ax = lgb.plot_metric(evals)
+    assert ax.get_xlabel() == "Iterations"
+    lines = ax.get_lines()
+    assert len(lines) == 1
+    assert len(lines[0].get_xdata()) == 10
+    with pytest.raises(TypeError):
+        lgb.plot_metric(trained[0])
+
+
+def test_plot_split_value_histogram(trained):
+    bst, _ = trained
+    imp = bst.feature_importance("split")
+    feat = int(np.argmax(imp))
+    ax = lgb.plot_split_value_histogram(bst, feat)
+    assert ax.get_xlabel() == "Feature split value"
+    with pytest.raises(ValueError):
+        unused = int(np.argmin(imp))
+        if imp[unused] > 0:
+            pytest.skip("all features used")
+        lgb.plot_split_value_histogram(bst, unused)
+
+
+def test_get_split_value_histogram(trained):
+    bst, _ = trained
+    imp = bst.feature_importance("split")
+    feat = int(np.argmax(imp))
+    hist, edges = bst.get_split_value_histogram(feat)
+    assert hist.sum() == imp[feat]
+    assert len(edges) == len(hist) + 1
+    df = bst.get_split_value_histogram(feat, xgboost_style=True)
+    assert df["Count"].sum() == imp[feat]
+
+
+def test_create_tree_digraph(trained):
+    bst, _ = trained
+    g = lgb.plotting.create_tree_digraph(
+        bst, tree_index=1, show_info=["split_gain", "internal_count", "leaf_count"])
+    s = g.source
+    assert "graph" in s or "digraph" in s
+    assert "split1" in s or "split0" in s
+    with pytest.raises(IndexError):
+        lgb.plotting.create_tree_digraph(bst, tree_index=10**6)
+
+
+def test_trees_to_dataframe(trained):
+    bst, _ = trained
+    df = bst.trees_to_dataframe()
+    assert set(df.columns) >= {"tree_index", "node_depth", "node_index",
+                               "split_feature", "threshold", "value", "count"}
+    assert df["tree_index"].nunique() == 10
+    # each tree: num_leaves leaves + num_leaves-1 internal nodes
+    t0 = df[df.tree_index == 0]
+    leaves = t0[t0.split_feature.isna()]
+    internals = t0[~t0.split_feature.isna()]
+    assert len(leaves) == len(internals) + 1
+    # leaf counts sum to dataset size at every tree
+    assert leaves["count"].sum() == 1500
+
+
+def test_sklearn_plot_metric(binary_data):
+    X, y, Xt, yt = binary_data
+    clf = lgb.LGBMClassifier(n_estimators=5, num_leaves=7, verbose=-1)
+    clf.fit(X, y, eval_set=[(Xt, yt)])
+    ax = lgb.plot_metric(clf)
+    assert len(ax.get_lines()) == 1
