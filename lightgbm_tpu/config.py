@@ -301,7 +301,13 @@ class Config:
     num_gpu: int = 1
     # TPU-specific knobs (new in this framework):
     hist_dtype: str = "float32"               # histogram accumulator dtype
-    hist_chunk_rows: int = 65536              # rows per one-hot matmul chunk
+    hist_chunk_rows: int = 8192               # rows per one-hot matmul chunk
+    # adaptive leaf compaction: gather the smaller sibling's rows into the
+    # tightest power-of-4 capacity bucket before histogramming, so per-split
+    # cost tracks leaf size (the TPU analog of the reference's per-leaf
+    # DataPartition index ranges) instead of full-dataset masking
+    hist_compact: bool = True
+    hist_compact_min_cap: int = 8192          # smallest gather bucket
     mesh_shape: List[int] = field(default_factory=list)   # device mesh, [] = all devices on one axis
     donate_state: bool = True
 
@@ -335,6 +341,13 @@ class Config:
 
     def _coerce(self, key: str, value: Any) -> Any:
         cur = getattr(self, key)
+        if key == "interaction_constraints":
+            # nested-list grammar "[0,1,2],[2,3]" (reference config.h:614)
+            if isinstance(value, str):
+                import re
+                return [[int(x) for x in grp.replace(",", " ").split()]
+                        for grp in re.findall(r"\[([^\]]*)\]", value)]
+            return [list(g) for g in value]
         if isinstance(cur, bool):
             if isinstance(value, str):
                 return value.lower() in ("true", "1", "yes", "+", "on")

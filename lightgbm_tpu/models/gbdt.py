@@ -116,7 +116,76 @@ class GBDT:
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth, max_bin=max_bin,
             split=sp, feature_fraction_bynode=cfg.feature_fraction_bynode,
             hist_method=("scatter" if jax.default_backend() == "cpu" else "onehot"),
-            hist_chunk_rows=cfg.hist_chunk_rows)
+            hist_chunk_rows=cfg.hist_chunk_rows,
+            cegb_split_penalty=cfg.cegb_tradeoff * cfg.cegb_penalty_split,
+            hist_compact=cfg.hist_compact,
+            hist_compact_min_cap=cfg.hist_compact_min_cap)
+
+    # ------------------------------------------------------------------
+    # feature-gating state: interaction constraints + CEGB (SURVEY.md §2.4)
+    def _interaction_sets(self):
+        """[C, F_inner] 0/1 matrix of interaction-constraint groups over inner
+        feature ids, or None (``col_sampler.hpp:74``)."""
+        groups = self.config.interaction_constraints
+        if not groups:
+            return None
+        used = list(self.train_data.used_features)
+        real2inner = {r: i for i, r in enumerate(used)}
+        mat = np.zeros((len(groups), len(used)), np.float32)
+        for c, grp in enumerate(groups):
+            for real in grp:
+                if real in real2inner:
+                    mat[c, real2inner[real]] = 1.0
+        return jnp.asarray(mat)
+
+    def _forced_splits(self):
+        """Parse ``forcedsplits_filename`` into the grower's static BFS tuple
+        (side, inner_feature, threshold_bin, parent_forced_idx); the grower
+        resolves target leaf ids at runtime (a forced split that fails its
+        gates must not shift its siblings' numbering)."""
+        fname = self.config.forcedsplits_filename
+        if not fname:
+            return ()
+        import json
+        with open(fname) as fh:
+            root = json.load(fh)
+        ds = self.train_data
+        real2inner = {r: i for i, r in enumerate(ds.used_features)}
+        out = []
+        queue = [(root, 0, -1)]
+        while queue and len(out) < self.config.num_leaves - 1:
+            node, side, par = queue.pop(0)
+            if not node:
+                continue
+            real_f = int(node["feature"])
+            if real_f not in real2inner:
+                Log.warning("forced split on unused feature %d ignored", real_f)
+                continue
+            mapper = ds.bin_mappers[real_f]
+            thr_bin = int(np.asarray(
+                mapper.value_to_bin(np.array([float(node["threshold"])])))[0])
+            idx = len(out)
+            out.append((side, real2inner[real_f], thr_bin, par))
+            if node.get("left"):
+                queue.append((node["left"], 0, idx))
+            if node.get("right"):
+                queue.append((node["right"], 1, idx))
+        return tuple(out)
+
+    def _cegb_vectors(self):
+        """(coupled[F_inner]|None, lazy[F_inner]|None), tradeoff-premultiplied."""
+        cfg = self.config
+        used = list(self.train_data.used_features)
+
+        def vec(pen):
+            if not pen:
+                return None
+            if len(pen) < self.train_data.num_total_features:
+                raise LightGBMError(
+                    "cegb_penalty_feature_* should be the same size as feature number")
+            return jnp.asarray([cfg.cegb_tradeoff * pen[r] for r in used],
+                               jnp.float32)
+        return vec(cfg.cegb_penalty_feature_coupled), vec(cfg.cegb_penalty_feature_lazy)
 
     def add_valid_data(self, valid_data: Dataset, name: str) -> None:
         check(valid_data.reference is self.train_data or
@@ -200,13 +269,20 @@ class GBDT:
         should_stop = True
         for k in range(K):
             with global_timer.scope("GBDT::grow_tree"):
+                cegb_coupled, cegb_used = self._cegb_state()
                 tree_arrays, node_assign = self._grow_jit(
                     self._dd.bins, g[k], h[k], row_weight, fmask,
-                    key_for_iteration(cfg.seed, it, salt=k + 1))
-            nl = int(tree_arrays.num_leaves)
+                    key_for_iteration(cfg.seed, it, salt=k + 1),
+                    cegb_coupled, cegb_used)
+            # ONE host fetch for the whole tree: over a remote-tunnel backend
+            # each np.asarray is a ~90ms round-trip, so per-field pulls
+            # dominate training time
+            tree_host = jax.device_get(tree_arrays)
+            self._cegb_update(tree_host, node_assign, bag_mask)
+            nl = int(tree_host.num_leaves)
             if nl > 1:
                 should_stop = False
-            tree = Tree.from_arrays(tree_arrays, self.train_data, learning_rate=1.0)
+            tree = Tree.from_arrays(tree_host, self.train_data, learning_rate=1.0)
 
             # leaf renewal for L1-style objectives (RenewTreeOutput,
             # serial_tree_learner.cpp:684)
@@ -259,12 +335,66 @@ class GBDT:
     def _grow_jit(self):
         dd = self._dd
         cfg = self._grower_cfg
+        inter = self._interaction_sets()
+        _, lazy = self._cegb_vectors()
+        forced = self._forced_splits()
 
         @jax.jit
-        def fn(bins, g, h, rw, fmask, key):
+        def fn(bins, g, h, rw, fmask, key, cegb_coupled, cegb_used):
             return grow_tree(bins, g, h, rw, fmask, dd.num_bins, dd.default_bins,
-                             dd.nan_bins, dd.is_categorical, dd.monotone, key, cfg)
+                             dd.nan_bins, dd.is_categorical, dd.monotone, key, cfg,
+                             interaction_sets=inter, cegb_coupled=cegb_coupled,
+                             cegb_lazy=lazy, cegb_used_data=cegb_used,
+                             forced=forced)
         return fn
+
+    def _cegb_state(self):
+        """Per-model CEGB accumulators, created lazily on first use."""
+        coupled, lazy = self._cegb_vectors()
+        if coupled is not None and not hasattr(self, "_cegb_feat_used"):
+            self._cegb_feat_used = np.zeros(self.train_data.num_features, bool)
+        if lazy is not None and not hasattr(self, "_cegb_used_data"):
+            self._cegb_used_data = jnp.zeros(
+                (self.train_data.num_data, self.train_data.num_features), bool)
+        coupled_arg = None
+        if coupled is not None:
+            coupled_arg = jnp.where(jnp.asarray(self._cegb_feat_used), 0.0, coupled)
+        used_arg = self._cegb_used_data if lazy is not None else None
+        return coupled_arg, used_arg
+
+    def _cegb_update(self, tree_arrays, node_assign, bag_mask):
+        """Fold one finished tree into the model-level CEGB state.
+
+        Rows were in a node at split time iff that node is an ancestor of the
+        row's final leaf, so the per-row feature costs paid by this tree are
+        exactly the features on each row's root->leaf path."""
+        nl = int(tree_arrays.num_leaves)
+        if nl <= 1:
+            return
+        if hasattr(self, "_cegb_feat_used"):
+            feats = np.asarray(tree_arrays.split_feature[:nl - 1], np.int64)
+            self._cegb_feat_used[feats[feats >= 0]] = True
+        if hasattr(self, "_cegb_used_data"):
+            L = self._grower_cfg.num_leaves
+            path = np.zeros((L, self.train_data.num_features), bool)
+            left = np.asarray(tree_arrays.left_child)
+            right = np.asarray(tree_arrays.right_child)
+            feat = np.asarray(tree_arrays.split_feature)
+            stack = [(0, [])]
+            while stack:
+                node, fs = stack.pop()
+                if node < 0:           # ~leaf_id
+                    path[~node, fs] = True
+                    continue
+                if feat[node] < 0:
+                    continue
+                fs2 = fs + [feat[node]]
+                stack.append((int(left[node]), fs2))
+                stack.append((int(right[node]), fs2))
+            paid = jnp.asarray(path)[node_assign]
+            if bag_mask is not None:
+                paid = paid & (bag_mask > 0)[:, None]
+            self._cegb_used_data = self._cegb_used_data | paid
 
     @functools.cached_property
     def _predict_leaf_jit(self):

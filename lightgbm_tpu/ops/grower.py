@@ -26,9 +26,9 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from .histogram import build_histogram
+from .histogram import build_histogram, gather_rows
 from .split import (NEG_INF, SplitParams, SplitResult, find_best_split,
-                    leaf_output, per_feature_gains)
+                    leaf_gain, leaf_output, per_feature_gains)
 
 
 def _reduce_split_global(s: SplitResult, axis_name: str) -> SplitResult:
@@ -83,6 +83,13 @@ class GrowerConfig(NamedTuple):
     parallel_mode: "str | None" = None
     top_k: int = 20               # voting: local proposals per leaf
     num_shards: int = 1           # static axis size (gates scaling in voting)
+    # CEGB (cost_effective_gradient_boosting.hpp): per-split penalty scaled by
+    # leaf row count, pre-multiplied by cegb_tradeoff
+    cegb_split_penalty: float = 0.0
+    # adaptive leaf compaction (see Config.hist_compact): gather the smaller
+    # sibling's rows into the tightest power-of-4 bucket before histogramming
+    hist_compact: bool = True
+    hist_compact_min_cap: int = 8192
 
 
 class TreeArrays(NamedTuple):
@@ -122,27 +129,55 @@ class _BestSplits(NamedTuple):
                    default_left=jnp.zeros(n, bool),
                    lg=z, lh=z, lc=z, rg=z, rh=z, rc=z, lout=z, rout=z)
 
-    def set_leaf(self, i, s: SplitResult) -> "_BestSplits":
+    def set_leaf(self, i, s: SplitResult, ok=None) -> "_BestSplits":
+        def u(arr, v):
+            if ok is None:
+                return arr.at[i].set(v)
+            return arr.at[i].set(jnp.where(ok, v, arr[i]))
         return _BestSplits(
-            gain=self.gain.at[i].set(s.gain),
-            feature=self.feature.at[i].set(s.feature),
-            threshold=self.threshold.at[i].set(s.threshold),
-            default_left=self.default_left.at[i].set(s.default_left),
-            lg=self.lg.at[i].set(s.left_sum_g), lh=self.lh.at[i].set(s.left_sum_h),
-            lc=self.lc.at[i].set(s.left_count),
-            rg=self.rg.at[i].set(s.right_sum_g), rh=self.rh.at[i].set(s.right_sum_h),
-            rc=self.rc.at[i].set(s.right_count),
-            lout=self.lout.at[i].set(s.left_output),
-            rout=self.rout.at[i].set(s.right_output))
+            gain=u(self.gain, s.gain),
+            feature=u(self.feature, s.feature),
+            threshold=u(self.threshold, s.threshold),
+            default_left=u(self.default_left, s.default_left),
+            lg=u(self.lg, s.left_sum_g), lh=u(self.lh, s.left_sum_h),
+            lc=u(self.lc, s.left_count),
+            rg=u(self.rg, s.right_sum_g), rh=u(self.rh, s.right_sum_h),
+            rc=u(self.rc, s.right_count),
+            lout=u(self.lout, s.left_output),
+            rout=u(self.rout, s.right_output))
 
 
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               row_weight: jax.Array, feature_mask: jax.Array,
               num_bins: jax.Array, default_bins: jax.Array, nan_bins: jax.Array,
               is_categorical: jax.Array, monotone: jax.Array,
-              key: jax.Array, cfg: GrowerConfig
+              key: jax.Array, cfg: GrowerConfig,
+              interaction_sets: "jax.Array | None" = None,
+              cegb_coupled: "jax.Array | None" = None,
+              cegb_lazy: "jax.Array | None" = None,
+              cegb_used_data: "jax.Array | None" = None,
+              forced: "Tuple[Tuple[int, int, int], ...]" = (),
               ) -> Tuple[TreeArrays, jax.Array]:
-    """Grow one tree.  Returns (tree, node_assignment[num_data])."""
+    """Grow one tree.  Returns (tree, node_assignment[num_data]).
+
+    Optional feature-gating state:
+      interaction_sets: ``[C, F]`` 0/1 — each row one interaction-constraint
+        group; a leaf may only split on features in some group containing all
+        its branch features (``col_sampler.hpp:91`` ``GetByNode``).
+      cegb_coupled: ``[F]`` tradeoff×coupled-penalty, already zeroed for
+        features used by earlier trees (``cegb_penalty_feature_coupled``).
+      cegb_lazy: ``[F]`` tradeoff×lazy-penalty (``cegb_penalty_feature_lazy``).
+      cegb_used_data: ``[N, F]`` bool — rows×features already "paid for" by
+        earlier trees (the reference's ``feature_used_in_data_`` bitset).
+      forced: static BFS-ordered forced splits as (side, inner_feature,
+        threshold_bin, parent_forced_idx) tuples
+        (``SerialTreeLearner::ForceSplits``, serial_tree_learner.cpp:450-562);
+        ``side`` is 0 for the root/left child of the parent forced split and
+        1 for its right child — target leaf ids are resolved at runtime so
+        a forced split that fails its validity gates (skipped, as the
+        reference erases negative-gain forced splits from forceSplitMap)
+        does not shift later forced splits' leaf numbering.
+    """
     n, f = bins.shape
     L = cfg.num_leaves
     B = cfg.max_bin
@@ -171,11 +206,41 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         is_cat_l, mono_l = is_categorical, monotone
         f_full = f
 
-    def hist_of(mask):
-        h = build_histogram(bins, grad, hess, mask, B,
-                            method=cfg.hist_method,
-                            chunk_rows=cfg.hist_chunk_rows)
+    # capacity ladder for adaptive leaf compaction: per-split histogram cost
+    # tracks the smaller sibling's size (the reference computes only over
+    # per-leaf index ranges, data_partition.hpp; full-mask passes would make
+    # every split O(N))
+    caps: "list[int]" = []
+    if cfg.hist_compact:
+        c = min(cfg.hist_compact_min_cap, n)
+        while c < n:
+            caps.append(c)
+            c *= 4
+    caps.append(n)
+
+    def hist_of(mask, nrows=None):
+        def full(m):
+            return build_histogram(bins, grad, hess, m, B,
+                                   method=cfg.hist_method,
+                                   chunk_rows=cfg.hist_chunk_rows)
+
+        if nrows is None or len(caps) == 1:
+            h = full(mask)
+        else:
+            def mk(cap):
+                def br(m):
+                    bc, gc, hc, mc = gather_rows(bins, grad, hess, m, cap)
+                    return build_histogram(bc, gc, hc, mc, B,
+                                           method=cfg.hist_method,
+                                           chunk_rows=cfg.hist_chunk_rows)
+                return br
+            branches = [mk(c) for c in caps[:-1]] + [full]
+            idx = jnp.searchsorted(jnp.asarray(caps, jnp.int32),
+                                   nrows.astype(jnp.int32))
+            h = jax.lax.switch(idx, branches, mask)
         if mode == "data":
+            # collective stays OUTSIDE the data-dependent switch: shards may
+            # pick different buckets, all join here
             h = jax.lax.psum(h, axis)
         return h
 
@@ -191,26 +256,29 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return jnp.where(u >= thresh, feature_mask, 0.0)
 
     def find(hist, sum_g, sum_h, count, fmask, parent_output=0.0,
-             lo=NEG_INF, hi=-NEG_INF):
+             lo=NEG_INF, hi=-NEG_INF, penalty=None):
         """Mode-dispatched best-split search (the analog of the reference's
         learner-specific FindBestSplitsFromHistograms overrides)."""
         if mode == "feature":
             fmask_l = jax.lax.dynamic_slice_in_dim(fmask, f_start, f)
+            pen_l = (jax.lax.dynamic_slice_in_dim(penalty, f_start, f)
+                     if penalty is not None else None)
             s = find_best_split(hist, num_bins_l, default_bins_l, nan_bins_l,
                                 is_cat_l, mono_l, sum_g, sum_h, count, p,
-                                fmask_l, parent_output, lo, hi)
+                                fmask_l, parent_output, lo, hi, pen_l)
             # local winner carries a shard-local feature id; globalize and
             # allreduce-max the packed SplitInfo (parallel_tree_learner.h:191)
             s = s._replace(feature=s.feature + f_start)
             return _reduce_split_global(s, axis)
         if mode == "voting":
             return _find_voting(hist, sum_g, sum_h, count, fmask,
-                                parent_output, lo, hi)
+                                parent_output, lo, hi, penalty)
         return find_best_split(hist, num_bins_l, default_bins_l, nan_bins_l,
                                is_cat_l, mono_l, sum_g, sum_h, count, p,
-                               fmask, parent_output, lo, hi)
+                               fmask, parent_output, lo, hi, penalty)
 
-    def _find_voting(hist, sum_g, sum_h, count, fmask, parent_output, lo, hi):
+    def _find_voting(hist, sum_g, sum_h, count, fmask, parent_output, lo, hi,
+                     penalty=None):
         """Local top-k proposal → global vote → reduce only elected
         histograms (voting_parallel_tree_learner.cpp:151-345)."""
         # local gains with min-data/hessian gates scaled to the shard
@@ -237,7 +305,36 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         emask = jnp.where(fmask > 0, emask, 0.0)
         return find_best_split(hist_e, num_bins_l, default_bins_l, nan_bins_l,
                                is_cat_l, mono_l, sum_g, sum_h, count, p,
-                               emask, parent_output, lo, hi)
+                               emask, parent_output, lo, hi, penalty)
+
+    use_cegb = (cegb_coupled is not None or cegb_lazy is not None
+                or cfg.cegb_split_penalty > 0.0)
+    if cegb_lazy is not None and cegb_used_data is None:
+        cegb_used_data = jnp.zeros((n, f_full), bool)
+    rw_pos = (row_weight > 0).astype(jnp.float32)
+
+    def interaction_allowed(branch):
+        """[F] 0/1 mask of features a leaf with branch-feature indicator
+        ``branch`` may split on: the union of constraint groups that contain
+        every branch feature (``col_sampler.hpp:91`` ``GetByNode``)."""
+        ok_c = ~jnp.any((branch[None, :] > 0) & (interaction_sets <= 0), axis=1)
+        return jnp.any((interaction_sets > 0) & ok_c[:, None], axis=0) \
+            .astype(jnp.float32)
+
+    def cegb_penalty(leaf_mask, count, feat_used, used_data):
+        """[F] CEGB gain penalty for splitting the leaf covered by
+        ``leaf_mask`` (reference ``DetlaGain``,
+        cost_effective_gradient_boosting.hpp:67-85)."""
+        pen = jnp.full(f_full, cfg.cegb_split_penalty * count, jnp.float32)
+        if cegb_coupled is not None:
+            pen = pen + jnp.where(feat_used, 0.0, cegb_coupled)
+        if cegb_lazy is not None:
+            # on-demand cost: rows in the leaf that never paid for feature f
+            unused = leaf_mask @ (1.0 - used_data.astype(jnp.float32))  # [F]
+            if mode in ("data", "voting"):
+                unused = jax.lax.psum(unused, axis)
+            pen = pen + cegb_lazy * unused
+        return pen
 
     # ---- degenerate case: no usable features -> single-leaf tree -----------
     if f == 0:
@@ -271,7 +368,16 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # data_parallel_tree_learner.cpp:126-152); feature-parallel replicates
         # rows so local sums are already global
         tot = jax.lax.psum(tot, axis)
-    root_split = find(root_hist, tot[0], tot[1], tot[2], node_feature_mask(0))
+    fmask0 = node_feature_mask(0)
+    if interaction_sets is not None:
+        fmask0 = fmask0 * interaction_allowed(jnp.zeros(f_full, jnp.float32))
+    pen0 = None
+    if use_cegb:
+        pen0 = cegb_penalty(
+            rw_pos, tot[2],
+            jnp.zeros(f_full, bool) if cegb_coupled is not None else None,
+            cegb_used_data)
+    root_split = find(root_hist, tot[0], tot[1], tot[2], fmask0, penalty=pen0)
 
     hist_store = jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist)
     best = _BestSplits.empty(L).set_leaf(0, root_split)
@@ -301,117 +407,276 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         node_count=jnp.zeros(L - 1, jnp.float32),
         num_leaves=jnp.int32(1),
     )
+    if interaction_sets is not None:
+        state["leaf_branch"] = jnp.zeros((L, f_full), jnp.float32)
+    if cegb_coupled is not None:
+        state["feat_used"] = jnp.zeros(f_full, bool)
+    if cegb_lazy is not None:
+        state["used_data"] = cegb_used_data
 
-    def split_step(j, st):
-        bestg = jnp.where(jnp.arange(L) < st["num_leaves"], st["best"].gain, NEG_INF)
-        leaf = jnp.argmax(bestg).astype(jnp.int32)
-        gain = bestg[leaf]
+    def forced_split_info(st, leaf, feat, thr):
+        """SplitInfo for a forced (feature, threshold-bin) split of a leaf,
+        from its stored histogram (the reference's
+        ``GatherInfoForThreshold``, feature_histogram.hpp)."""
+        h = st["hist"][leaf][feat]                                   # [B, 3]
+        total = jnp.stack([st["leaf_sum_g"][leaf], st["leaf_weight"][leaf],
+                           st["leaf_count"][leaf]])
+        bin_ids = jnp.arange(B)
+        miss_b = nan_bins[feat]
+        num_left = jnp.sum(
+            jnp.where(((bin_ids <= thr) & (bin_ids != miss_b))[:, None], h, 0.0),
+            axis=0)                                                  # missing -> right
+        left = jnp.where(is_categorical[feat], h[thr], num_left)
+        right = total - left
+        lo, hi = st["leaf_lo"][leaf], st["leaf_hi"][leaf]
+        lout = leaf_output(left[0], left[1], p, 0.0, left[2], lo, hi)
+        rout = leaf_output(right[0], right[1], p, 0.0, right[2], lo, hi)
+        gain = (leaf_gain(left[0], left[1], p, 0.0, left[2], lo, hi)
+                + leaf_gain(right[0], right[1], p, 0.0, right[2], lo, hi)
+                - leaf_gain(total[0], total[1], p, 0.0, total[2], lo, hi))
+        ok = ((left[2] >= p.min_data_in_leaf) & (right[2] >= p.min_data_in_leaf)
+              & (left[1] >= p.min_sum_hessian_in_leaf)
+              & (right[1] >= p.min_sum_hessian_in_leaf) & (gain > 0))
+        return SplitResult(
+            gain=jnp.where(ok, gain, NEG_INF),
+            feature=jnp.int32(feat), threshold=jnp.int32(thr),
+            default_left=jnp.asarray(False),
+            left_sum_g=left[0], left_sum_h=left[1], left_count=left[2],
+            right_sum_g=right[0], right_sum_h=right[1], right_count=right[2],
+            left_output=lout, right_output=rout)
 
-        def do_split(st):
-            b = st["best"]
-            feat = b.feature[leaf]
-            thr = b.threshold[leaf]
-            dleft = b.default_left[leaf]
-            f_is_cat = is_categorical[feat]
-            new_id = st["num_leaves"]
+    def apply_split(j, st, leaf, gain, ok):
+        """Apply the pending best split of ``leaf`` as node ``j``.
 
-            # --- update node arrays + parent linkage ---
-            parent_node = st["leaf_parent"][leaf]
-            st_nf = st["node_feature"].at[j].set(feat)
-            st_nt = st["node_threshold"].at[j].set(thr)
-            st_nd = st["node_default_left"].at[j].set(dleft)
-            st_nc = st["node_is_cat"].at[j].set(f_is_cat)
-            st_ng = st["node_gain"].at[j].set(gain)
-            st_np = st["node_parent"].at[j].set(parent_node)
-            st_nl = st["node_is_left"].at[j].set(st["leaf_is_left"][leaf])
-            st_nv = st["node_value"].at[j].set(leaf_output(
-                st["leaf_sum_g"][leaf], st["leaf_weight"][leaf], p,
-                0.0, st["leaf_count"][leaf]))
-            st_ncount = st["node_count"].at[j].set(st["leaf_count"][leaf])
+        ``ok is None`` means the caller guarantees the split is valid (the
+        while-loop body, whose condition already checked gain > 0) and every
+        write is unconditional — this keeps the loop free of ``lax.cond``,
+        which would copy the multi-MB histogram store every step instead of
+        updating it in place.  The forced-split prefix passes a traced ``ok``
+        and all writes are predicated."""
+        unconditional = ok is None
 
-            # --- partition rows of this leaf ---
-            if mode == "feature":
-                # only the shard owning the winning feature can decide; it
-                # broadcasts the decision (the reference avoids this because
-                # every rank holds every column — here columns are sharded,
-                # so one [n] psum replaces replicated column storage)
-                local_ix = jnp.clip(feat - f_start, 0, f - 1)
-                owns = (feat >= f_start) & (feat < f_start + f)
-                col = jnp.take(bins, local_ix, axis=1).astype(jnp.int32)
-            else:
-                col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
-            is_miss = (col == nan_bins[feat]) & (nan_bins[feat] >= 0)
-            goes_left = jnp.where(
-                f_is_cat, col == thr,
-                jnp.where(is_miss, dleft, col <= thr))
-            if mode == "feature":
-                goes_left = jax.lax.psum(
-                    jnp.where(owns, goes_left.astype(jnp.float32), 0.0),
-                    axis) > 0.5
-            in_leaf = st["node_assign"] == leaf
-            node_assign = jnp.where(in_leaf & ~goes_left, new_id, st["node_assign"])
+        def setw(arr, idx, val):
+            if unconditional:
+                return arr.at[idx].set(val)
+            return arr.at[idx].set(jnp.where(ok, val, arr[idx]))
 
-            # --- child histograms: compute smaller, subtract for larger ---
-            left_smaller = b.lc[leaf] <= b.rc[leaf]
-            small_mask = jnp.where(in_leaf & (goes_left == left_smaller),
-                                   row_weight, 0.0)
-            small_hist = hist_of(small_mask)
-            parent_hist = st["hist"][leaf]
-            large_hist = parent_hist - small_hist
-            lhist = jnp.where(left_smaller, small_hist, large_hist)
-            rhist = parent_hist - lhist
-            hist = st["hist"].at[leaf].set(lhist).at[new_id].set(rhist)
+        def gate(cond):
+            return cond if unconditional else (cond & ok)
 
-            # --- child bookkeeping ---
-            depth = st["leaf_depth"][leaf] + 1
-            leaf_depth = st["leaf_depth"].at[leaf].set(depth).at[new_id].set(depth)
-            leaf_value = st["leaf_value"].at[leaf].set(b.lout[leaf]).at[new_id].set(b.rout[leaf])
-            leaf_count = st["leaf_count"].at[leaf].set(b.lc[leaf]).at[new_id].set(b.rc[leaf])
-            leaf_weight = st["leaf_weight"].at[leaf].set(b.lh[leaf]).at[new_id].set(b.rh[leaf])
-            leaf_sum_g = st["leaf_sum_g"].at[leaf].set(b.lg[leaf]).at[new_id].set(b.rg[leaf])
-            leaf_parent = st["leaf_parent"].at[leaf].set(j).at[new_id].set(j)
-            leaf_is_left = st["leaf_is_left"].at[leaf].set(True).at[new_id].set(False)
+        b = st["best"]
+        feat = b.feature[leaf]
+        thr = b.threshold[leaf]
+        dleft = b.default_left[leaf]
+        f_is_cat = is_categorical[feat]
+        new_id = st["num_leaves"]
 
-            # monotone (basic): children inherit bounds; split on a monotone
-            # feature pinches them at the midpoint of the child outputs
-            mono = monotone[feat]
-            lo, hi = st["leaf_lo"][leaf], st["leaf_hi"][leaf]
-            mid = (b.lout[leaf] + b.rout[leaf]) * 0.5
-            l_lo = jnp.where(mono < 0, jnp.maximum(lo, mid), lo)
-            l_hi = jnp.where(mono > 0, jnp.minimum(hi, mid), hi)
-            r_lo = jnp.where(mono > 0, jnp.maximum(lo, mid), lo)
-            r_hi = jnp.where(mono < 0, jnp.minimum(hi, mid), hi)
-            leaf_lo = st["leaf_lo"].at[leaf].set(l_lo).at[new_id].set(r_lo)
-            leaf_hi = st["leaf_hi"].at[leaf].set(l_hi).at[new_id].set(r_hi)
+        # --- update node arrays + parent linkage ---
+        parent_node = st["leaf_parent"][leaf]
+        st_nf = setw(st["node_feature"], j, feat)
+        st_nt = setw(st["node_threshold"], j, thr)
+        st_nd = setw(st["node_default_left"], j, dleft)
+        st_nc = setw(st["node_is_cat"], j, f_is_cat)
+        st_ng = setw(st["node_gain"], j, gain)
+        st_np = setw(st["node_parent"], j, parent_node)
+        st_nl = setw(st["node_is_left"], j, st["leaf_is_left"][leaf])
+        st_nv = setw(st["node_value"], j, leaf_output(
+            st["leaf_sum_g"][leaf], st["leaf_weight"][leaf], p,
+            0.0, st["leaf_count"][leaf]))
+        st_ncount = setw(st["node_count"], j, st["leaf_count"][leaf])
 
-            # --- new best splits for both children ---
-            fmask = node_feature_mask(j + 1)
-            depth_ok = (cfg.max_depth <= 0) | (depth < cfg.max_depth)
+        # --- partition rows of this leaf ---
+        if mode == "feature":
+            # only the shard owning the winning feature can decide; it
+            # broadcasts the decision (the reference avoids this because
+            # every rank holds every column — here columns are sharded,
+            # so one [n] psum replaces replicated column storage)
+            local_ix = jnp.clip(feat - f_start, 0, f - 1)
+            owns = (feat >= f_start) & (feat < f_start + f)
+            col = jnp.take(bins, local_ix, axis=1).astype(jnp.int32)
+        else:
+            col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+        is_miss = (col == nan_bins[feat]) & (nan_bins[feat] >= 0)
+        goes_left = jnp.where(
+            f_is_cat, col == thr,
+            jnp.where(is_miss, dleft, col <= thr))
+        if mode == "feature":
+            goes_left = jax.lax.psum(
+                jnp.where(owns, goes_left.astype(jnp.float32), 0.0),
+                axis) > 0.5
+        in_leaf = st["node_assign"] == leaf
+        node_assign = jnp.where(gate(in_leaf & ~goes_left), new_id,
+                                st["node_assign"])
 
-            def child_best(hist_c, g, h, c, lo_, hi_):
-                s = find(hist_c, g, h, c, fmask, 0.0, lo_, hi_)
-                return s._replace(gain=jnp.where(depth_ok, s.gain, NEG_INF))
+        # --- child histograms: compute smaller, subtract for larger ---
+        left_smaller = b.lc[leaf] <= b.rc[leaf]
+        small_mask = jnp.where(in_leaf & (goes_left == left_smaller),
+                               row_weight, 0.0)
+        small_hist = hist_of(small_mask, jnp.sum(small_mask > 0))
+        parent_hist = st["hist"][leaf]
+        large_hist = parent_hist - small_hist
+        lhist = jnp.where(left_smaller, small_hist, large_hist)
+        rhist = parent_hist - lhist
+        hist = setw(setw(st["hist"], leaf, lhist), new_id, rhist)
 
-            sl = child_best(lhist, b.lg[leaf], b.lh[leaf], b.lc[leaf], l_lo, l_hi)
-            sr = child_best(rhist, b.rg[leaf], b.rh[leaf], b.rc[leaf], r_lo, r_hi)
-            best = st["best"].set_leaf(leaf, sl).set_leaf(new_id, sr)
+        # --- child bookkeeping ---
+        depth = st["leaf_depth"][leaf] + 1
+        leaf_depth = setw(setw(st["leaf_depth"], leaf, depth), new_id, depth)
+        leaf_value = setw(setw(st["leaf_value"], leaf, b.lout[leaf]),
+                          new_id, b.rout[leaf])
+        leaf_count = setw(setw(st["leaf_count"], leaf, b.lc[leaf]),
+                          new_id, b.rc[leaf])
+        leaf_weight = setw(setw(st["leaf_weight"], leaf, b.lh[leaf]),
+                           new_id, b.rh[leaf])
+        leaf_sum_g = setw(setw(st["leaf_sum_g"], leaf, b.lg[leaf]),
+                          new_id, b.rg[leaf])
+        leaf_parent = setw(setw(st["leaf_parent"], leaf, j), new_id, j)
+        leaf_is_left = setw(setw(st["leaf_is_left"], leaf, True),
+                            new_id, False)
 
-            return dict(
-                node_assign=node_assign, hist=hist, best=best,
-                leaf_depth=leaf_depth, leaf_value=leaf_value,
-                leaf_count=leaf_count, leaf_weight=leaf_weight,
-                leaf_sum_g=leaf_sum_g, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
-                leaf_parent=leaf_parent, leaf_is_left=leaf_is_left,
-                node_feature=st_nf, node_threshold=st_nt,
-                node_default_left=st_nd, node_is_cat=st_nc, node_gain=st_ng,
-                node_parent=st_np, node_is_left=st_nl, node_value=st_nv,
-                node_count=st_ncount,
-                num_leaves=st["num_leaves"] + 1,
-            )
+        # monotone (basic): children inherit bounds; split on a monotone
+        # feature pinches them at the midpoint of the child outputs
+        mono = monotone[feat]
+        lo, hi = st["leaf_lo"][leaf], st["leaf_hi"][leaf]
+        mid = (b.lout[leaf] + b.rout[leaf]) * 0.5
+        l_lo = jnp.where(mono < 0, jnp.maximum(lo, mid), lo)
+        l_hi = jnp.where(mono > 0, jnp.minimum(hi, mid), hi)
+        r_lo = jnp.where(mono > 0, jnp.maximum(lo, mid), lo)
+        r_hi = jnp.where(mono < 0, jnp.minimum(hi, mid), hi)
+        leaf_lo = setw(setw(st["leaf_lo"], leaf, l_lo), new_id, r_lo)
+        leaf_hi = setw(setw(st["leaf_hi"], leaf, l_hi), new_id, r_hi)
 
-        return jax.lax.cond(gain > 0.0, do_split, lambda s: s, st)
+        # --- feature-gating state: interaction branch sets, CEGB ---
+        extra = {}
+        fmask = node_feature_mask(j + 1)
+        if interaction_sets is not None:
+            # both children share the branch = parent branch + this feature
+            branch = jnp.where(jnp.arange(f_full) == feat, 1.0,
+                               st["leaf_branch"][leaf])
+            fmask = fmask * interaction_allowed(branch)
+            extra["leaf_branch"] = setw(
+                setw(st["leaf_branch"], leaf, branch), new_id, branch)
+        cur_best = st["best"]
+        feat_used = None
+        if cegb_coupled is not None:
+            # the coupled penalty is paid once per feature per model: mark
+            # it used and refund the penalty in other leaves' cached best
+            # gains that proposed the same feature (the reference's
+            # UpdateLeafBestSplits correction)
+            refund = jnp.where(st["feat_used"][feat], 0.0, cegb_coupled[feat])
+            cur_best = cur_best._replace(gain=jnp.where(
+                gate((cur_best.feature == feat)
+                     & (cur_best.gain > NEG_INF / 2)),
+                cur_best.gain + refund, cur_best.gain))
+            feat_used = st["feat_used"].at[feat].set(
+                st["feat_used"][feat] | (True if unconditional else ok))
+            extra["feat_used"] = feat_used
+        used_data = None
+        if cegb_lazy is not None:
+            # rows of the split leaf have now paid feature `feat`'s
+            # on-demand cost (feature_used_in_data_ bitset insert)
+            used_data = st["used_data"] | (
+                gate(in_leaf & (row_weight > 0))[:, None]
+                & (jnp.arange(f_full) == feat)[None, :])
+            extra["used_data"] = used_data
 
-    state = jax.lax.fori_loop(0, L - 1, split_step, state)
+        # --- new best splits for both children ---
+        depth_ok = (cfg.max_depth <= 0) | (depth < cfg.max_depth)
+
+        def child_best(hist_c, g, h, c, lo_, hi_, mask_c):
+            pen = None
+            if use_cegb:
+                pen = cegb_penalty(mask_c, c, feat_used, used_data)
+            s = find(hist_c, g, h, c, fmask, 0.0, lo_, hi_, penalty=pen)
+            return s._replace(gain=jnp.where(depth_ok, s.gain, NEG_INF))
+
+        lmask = jnp.where(in_leaf & goes_left, rw_pos, 0.0)
+        rmask = jnp.where(in_leaf & ~goes_left, rw_pos, 0.0)
+        sl = child_best(lhist, b.lg[leaf], b.lh[leaf], b.lc[leaf],
+                        l_lo, l_hi, lmask)
+        sr = child_best(rhist, b.rg[leaf], b.rh[leaf], b.rc[leaf],
+                        r_lo, r_hi, rmask)
+        best = cur_best.set_leaf(leaf, sl, ok).set_leaf(new_id, sr, ok)
+
+        return dict(
+            **extra,
+            node_assign=node_assign, hist=hist, best=best,
+            leaf_depth=leaf_depth, leaf_value=leaf_value,
+            leaf_count=leaf_count, leaf_weight=leaf_weight,
+            leaf_sum_g=leaf_sum_g, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
+            leaf_parent=leaf_parent, leaf_is_left=leaf_is_left,
+            node_feature=st_nf, node_threshold=st_nt,
+            node_default_left=st_nd, node_is_cat=st_nc, node_gain=st_ng,
+            node_parent=st_np, node_is_left=st_nl, node_value=st_nv,
+            node_count=st_ncount,
+            num_leaves=st["num_leaves"] + (
+                1 if unconditional else ok.astype(jnp.int32)),
+        )
+
+    # forced splits first (unrolled BFS prefix with runtime-tracked leaf ids
+    # and node slots, so a forced split that fails its gates leaves no gap in
+    # the node arrays and does not shift later siblings' leaf numbering),
+    # then best-gain growth
+    if forced and mode in ("feature", "voting"):
+        raise NotImplementedError(
+            "forced splits are not supported with the feature/voting "
+            "parallel learners (shard-local histograms)")
+    forced_ok = []
+    forced_leaf_id = []      # traced leaf id each forced node targets
+    forced_right_id = []     # traced leaf id of each forced node's right child
+    for j in range(min(len(forced), L - 1)):
+        fside, ffeat, fthr, fpar = forced[j]
+        if fpar < 0:
+            fleaf = jnp.int32(0)
+        elif fside == 0:     # left child keeps the parent's leaf id
+            fleaf = forced_leaf_id[fpar]
+        else:                # right child got the fresh id at the parent split
+            fleaf = forced_right_id[fpar]
+        forced_leaf_id.append(fleaf)
+        forced_right_id.append(state["num_leaves"])  # id if this split lands
+        nl_before = state["num_leaves"]
+        finfo = forced_split_info(state, fleaf, ffeat, fthr)
+        if fpar >= 0:
+            # a forced split whose forced ancestor failed is dropped (the
+            # reference aborts the subtree, serial_tree_learner.cpp:543-553)
+            finfo = finfo._replace(
+                gain=jnp.where(forced_ok[fpar], finfo.gain, NEG_INF))
+        natural = state["best"]
+        state = dict(state, best=natural.set_leaf(fleaf, finfo))
+        fgain = state["best"].gain[fleaf]
+        # node slot = number of successful splits so far: failures leave the
+        # node arrays gapless
+        state = apply_split(state["num_leaves"] - 1, state, fleaf, fgain,
+                            fgain > 0.0)
+        ok = state["num_leaves"] > nl_before
+        forced_ok.append(ok)
+        # failed forced split: restore the leaf's natural best so the
+        # best-gain phase can still split it (forceSplitMap erase)
+        restored = _BestSplits(*[
+            c.at[fleaf].set(jnp.where(ok, c[fleaf], nat[fleaf]))
+            for c, nat in zip(state["best"], natural)])
+        state = dict(state, best=restored)
+
+    # best-gain growth: a while_loop that EXITS when no positive-gain split
+    # remains, so finished trees don't pay for dead iterations, and whose
+    # body is branch-free so XLA aliases the loop-carried histogram store
+    # in place (a lax.cond here copied the multi-MB buffers every step)
+    def loop_cond(carry):
+        jj, st = carry
+        active = jnp.where(jnp.arange(L) < st["num_leaves"],
+                           st["best"].gain, NEG_INF)
+        return (jj < L - 1) & (jnp.max(active) > 0.0)
+
+    def loop_body(carry):
+        jj, st = carry
+        active = jnp.where(jnp.arange(L) < st["num_leaves"],
+                           st["best"].gain, NEG_INF)
+        leaf = jnp.argmax(active).astype(jnp.int32)
+        st = apply_split(jj, st, leaf, active[leaf], None)
+        return jj + 1, st
+
+    _, state = jax.lax.while_loop(
+        loop_cond, loop_body, (state["num_leaves"] - 1, state))
 
     # ---- reconstruct child pointers ----------------------------------------
     # node j's children: initially leaves (~leaf ids); later splits of those

@@ -97,7 +97,8 @@ def leaf_gain(sum_g, sum_h, p: SplitParams, parent_output=0.0, count=None,
 
 def _split_gain_matrix(hist, num_bins, nan_bins, is_categorical, monotone,
                        total, p: SplitParams, feature_mask,
-                       parent_output, output_lo, output_hi):
+                       parent_output, output_lo, output_hi,
+                       gain_penalty=None):
     """Candidate gains over all (feature, threshold) pairs.
 
     Returns (gain_fb [F, B], use_left [F, B], cum [F, B, 3], miss [F, 3]).
@@ -140,6 +141,12 @@ def _split_gain_matrix(hist, num_bins, nan_bins, is_categorical, monotone,
                                  extra_l2=p.cat_l2)
     is_cat = is_categorical[:, None]
     gain_fb = jnp.where(is_cat, cat_gain, num_gain)                    # [F, B]
+    if gain_penalty is not None:
+        # CEGB: per-feature penalty subtracted from the candidate gain before
+        # the argmax (reference ``new_split.gain -= cegb_->DetlaGain(...)``,
+        # serial_tree_learner.cpp:740-744)
+        gain_fb = jnp.where(gain_fb > NEG_INF / 2,
+                            gain_fb - gain_penalty[:, None], gain_fb)
     gain_fb = jnp.where(feature_mask[:, None] > 0, gain_fb, NEG_INF)
     return gain_fb, use_left, cum, miss
 
@@ -162,8 +169,8 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
                     nan_bins: jax.Array, is_categorical: jax.Array,
                     monotone: jax.Array, sum_g, sum_h, count,
                     p: SplitParams, feature_mask: jax.Array,
-                    parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF
-                    ) -> SplitResult:
+                    parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF,
+                    gain_penalty=None) -> SplitResult:
     """Find the best split of a leaf given its histogram.
 
     Args:
@@ -178,7 +185,7 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
     total = jnp.stack([sum_g, sum_h, count]).astype(jnp.float32)       # [3]
     gain_fb, use_left, cum, miss = _split_gain_matrix(
         hist, num_bins, nan_bins, is_categorical, monotone, total, p,
-        feature_mask, parent_output, output_lo, output_hi)
+        feature_mask, parent_output, output_lo, output_hi, gain_penalty)
 
     # --- argmax over (feature, threshold) ------------------------------------
     flat = gain_fb.reshape(-1)

@@ -1,0 +1,122 @@
+"""Microbenchmark of histogram-kernel variants on the live backend.
+
+Run on the TPU (ambient axon backend):  python scripts/bench_hist.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def time_fn(fn, *args, iters=10):
+    """Time `iters` on-device repetitions inside ONE dispatch: the remote
+    tunnel adds ~90ms per host round-trip, so per-call host timing is useless.
+    A data dependence (g perturbed by the loop index) defeats CSE."""
+    bins, g, h, m = args
+
+    @jax.jit
+    def many(bins, g, h, m):
+        def body(acc, i):
+            hh = fn(bins, g + i * 1e-12, h, m)
+            return acc + jnp.sum(hh), None
+        acc, _ = jax.lax.scan(body, jnp.float32(0),
+                              jnp.arange(iters, dtype=jnp.float32))
+        return acc
+
+    float(many(bins, g, h, m))          # compile + warm
+    t0 = time.perf_counter()
+    s = float(many(bins, g, h, m))
+    total = time.perf_counter() - t0
+    return (total - 0.09) / iters       # subtract one tunnel round-trip
+
+
+def make_data(n, f, b, seed=0):
+    rng = np.random.default_rng(seed)
+    bins = jnp.asarray(rng.integers(0, b, size=(n, f), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1, size=n).astype(np.float32))
+    m = jnp.ones(n, jnp.float32)
+    return bins, g, h, m
+
+
+def hist_onehot_old(bins, g, h, m, B, chunk):
+    from lightgbm_tpu.ops.histogram import _hist_onehot
+    return _hist_onehot(bins, g, h, m, B, chunk)
+
+
+def hist_onehot_swapped(bins, g, h, m, B, chunk):
+    """gh on the left: [3, chunk] @ [chunk, F*B] -> [3, F*B]."""
+    n, f = bins.shape
+    gh = jnp.stack([g * m, h * m, m], axis=0).astype(jnp.float32)   # [3, N]
+    pad = (-n) % chunk
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        gh = jnp.pad(gh, ((0, 0), (0, pad)))
+    nc = (n + pad) // chunk
+    bins_c = bins.reshape(nc, chunk, f)
+    gh_c = gh.reshape(3, nc, chunk).transpose(1, 0, 2)              # [nc, 3, chunk]
+
+    def body(acc, xs):
+        b, gh_ = xs
+        flat = b.astype(jnp.int32) + B * jnp.arange(f, dtype=jnp.int32)[None, :]
+        onehot = (flat[:, :, None] ==
+                  jnp.arange(f * B, dtype=jnp.int32)[None, None, :])
+        # wait: this makes [chunk, F, F*B] - wrong. build per-feature block
+        return acc, None
+
+    # correct: one-hot per feature over B, reshaped to [chunk, F*B]
+    def body2(acc, xs):
+        b, gh_ = xs                                                  # [chunk,F],[3,chunk]
+        onehot = (b.astype(jnp.int32)[:, :, None] ==
+                  jnp.arange(B, dtype=jnp.int32)[None, None, :])
+        onehot = onehot.astype(jnp.float32).reshape(chunk, f * B)
+        hpart = jax.lax.dot_general(
+            gh_, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                      # [3, F*B]
+        return acc + hpart, None
+
+    init = jnp.zeros((3, f * B), jnp.float32)
+    if nc == 1:
+        out, _ = body2(init, (bins_c[0], gh_c[0]))
+    else:
+        out, _ = jax.lax.scan(body2, init, (bins_c, gh_c))
+    return out.reshape(3, f, B).transpose(1, 2, 0)
+
+
+def hist_scatter(bins, g, h, m, B, chunk):
+    from lightgbm_tpu.ops.histogram import _hist_scatter
+    return _hist_scatter(bins, g, h, m, B)
+
+
+def main():
+    print("backend:", jax.default_backend(), jax.devices()[0])
+    N, F, B = 1_000_000, 28, 256
+    bins, g, h, m = make_data(N, F, B)
+    ref = None
+    for name, fn, chunk in [
+        ("onehot_old c64k", hist_onehot_old, 65536),
+        ("onehot_old c8k", hist_onehot_old, 8192),
+        ("onehot_swap c64k", hist_onehot_swapped, 65536),
+        ("onehot_swap c8k", hist_onehot_swapped, 8192),
+        ("onehot_swap c128k", hist_onehot_swapped, 131072),
+        ("scatter", hist_scatter, 0),
+    ]:
+        try:
+            jf = jax.jit(lambda b_, g_, h_, m_, fn=fn, c=chunk: fn(b_, g_, h_, m_, B, c))
+            t = time_fn(lambda b_, g_, h_, m_, fn=fn, c=chunk: fn(b_, g_, h_, m_, B, c),
+                        bins, g, h, m, iters=20)
+            out = jf(bins, g, h, m)
+            if ref is None:
+                ref = np.asarray(out)
+                err = 0.0
+            else:
+                err = float(np.max(np.abs(np.asarray(out) - ref)))
+            rows_per_s = N / t
+            print(f"{name:20s} {t*1e3:8.2f} ms  {rows_per_s/1e6:8.1f} Mrows/s  maxerr={err:.2e}")
+        except Exception as e:
+            print(f"{name:20s} FAILED: {type(e).__name__} {str(e)[:120]}")
+
+
+if __name__ == "__main__":
+    main()

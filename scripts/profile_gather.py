@@ -1,0 +1,133 @@
+"""Decompose gather_rows cost; test scatter-free variants."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+F, CAP, REP = 28, 8192, 100
+rng = np.random.default_rng(0)
+bins = jnp.asarray(rng.integers(0, 256, size=(N, F), dtype=np.uint8))
+na = jnp.asarray(rng.integers(0, 255, size=N, dtype=np.int32))
+g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+
+
+def timed(name, fn, *args):
+    @jax.jit
+    def many(*a):
+        def body(acc, i):
+            return acc + fn(i, *a), None
+        acc, _ = jax.lax.scan(body, jnp.float32(0),
+                              jnp.arange(REP, dtype=jnp.int32))
+        return acc
+    float(many(*args))
+    t0 = time.perf_counter()
+    float(many(*args))
+    print(f"{name:30s} {(time.perf_counter()-t0-0.09)/REP*1e3:8.3f} ms/iter")
+
+
+def cumsum_only(i, na):
+    active = (na == (i % 255))
+    return jnp.sum(jnp.cumsum(active.astype(jnp.int32))).astype(jnp.float32) * 1e-9
+
+
+timed("cumsum", cumsum_only, na)
+
+
+def scatter_ids(i, na):
+    active = na == (i % 255)
+    pos = jnp.cumsum(active.astype(jnp.int32)) - 1
+    slot = jnp.where(active, pos, CAP)
+    row_ids = jnp.zeros(CAP, jnp.int32).at[slot].set(
+        jnp.arange(N, dtype=jnp.int32), mode="drop")
+    return jnp.sum(row_ids).astype(jnp.float32) * 1e-9
+
+
+timed("cumsum+scatter", scatter_ids, na)
+
+
+def searchsorted_ids(i, na):
+    active = na == (i % 255)
+    cs = jnp.cumsum(active.astype(jnp.int32))
+    row_ids = jnp.searchsorted(cs, jnp.arange(1, CAP + 1, dtype=jnp.int32),
+                               side="left")
+    return jnp.sum(row_ids).astype(jnp.float32) * 1e-9
+
+
+timed("cumsum+searchsorted", searchsorted_ids, na)
+
+
+row_ids_const = jnp.asarray(rng.integers(0, N, size=CAP, dtype=np.int32))
+
+
+def row_gather(i, bins, g):
+    ids = (row_ids_const + i) % N
+    bc = jnp.take(bins, ids, axis=0)
+    gc = jnp.take(g, ids)
+    return jnp.sum(gc) + jnp.sum(bc[:, 0].astype(jnp.float32)) * 1e-9
+
+
+timed("row gather cap=8k", row_gather, bins, g)
+
+
+def nonzero_ids(i, na):
+    active = na == (i % 255)
+    ids = jnp.nonzero(active, size=CAP, fill_value=N - 1)[0]
+    return jnp.sum(ids).astype(jnp.float32) * 1e-9
+
+
+timed("jnp.nonzero size=8k", nonzero_ids, na)
+
+
+def unrolled_ids(i, na):
+    active = na == (i % 255)
+    cs = jnp.cumsum(active.astype(jnp.int32))
+    targets = jnp.arange(1, CAP + 1, dtype=jnp.int32)
+    lo = jnp.zeros(CAP, jnp.int32)
+    span = 1 << max(0, (N - 1).bit_length())
+    while span >= 1:
+        mid = jnp.minimum(lo + span, N) - 1
+        lo = jnp.where(jnp.take(cs, mid) < targets, lo + span, lo)
+        span >>= 1
+    return jnp.sum(lo).astype(jnp.float32) * 1e-9
+
+
+timed("unrolled binsearch", unrolled_ids, na)
+
+
+def twolevel_ids(i, na):
+    S = 1024
+    nb = -(-N // S)
+    active = na == (i % 255)
+    act_i = jnp.pad(active.astype(jnp.int32), (0, nb * S - N))
+    blk_cnt = jnp.sum(act_i.reshape(nb, S), axis=1)          # [nb]
+    blk_cs = jnp.cumsum(blk_cnt)                              # [nb]
+    targets = jnp.arange(1, CAP + 1, dtype=jnp.int32)
+    # level 1: find block (search in [nb], VMEM-resident)
+    lo = jnp.zeros(CAP, jnp.int32)
+    span = 1 << max(0, (nb - 1).bit_length())
+    while span >= 1:
+        mid = jnp.minimum(lo + span, nb) - 1
+        lo = jnp.where(jnp.take(blk_cs, mid) < targets, lo + span, lo)
+        span >>= 1
+    blk = jnp.minimum(lo, nb - 1)
+    prev = jnp.where(blk > 0, jnp.take(blk_cs, blk - 1), 0)
+    t_in = targets - prev                                     # 1-based in block
+    # level 2: in-block cumsum gathered rows: gather the S-length block rows
+    # for each target and cumsum? instead gather in-block prefix via binary
+    # search over the original cs restricted to the block
+    cs = jnp.cumsum(act_i)
+    base = blk * S
+    lo2 = jnp.zeros(CAP, jnp.int32)
+    span = S
+    while span >= 1:
+        mid = jnp.minimum(lo2 + span, S) - 1
+        v = jnp.take(cs, base + mid) - prev
+        lo2 = jnp.where(v < t_in, lo2 + span, lo2)
+        span >>= 1
+    return jnp.sum(base + lo2).astype(jnp.float32) * 1e-9
+
+
+timed("twolevel binsearch", twolevel_ids, na)

@@ -1,0 +1,57 @@
+"""Isolate grow_tree cost on the live backend with config toggles.
+
+usage: python scripts/profile_grow.py [rows] [leaves] [compact(0/1)] [chunk]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops.grower import GrowerConfig, grow_tree
+from lightgbm_tpu.ops.split import SplitParams
+
+rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+leaves = int(sys.argv[2]) if len(sys.argv) > 2 else 255
+compact = bool(int(sys.argv[3])) if len(sys.argv) > 3 else True
+chunk = int(sys.argv[4]) if len(sys.argv) > 4 else 8192
+
+F, B = 28, 256
+rng = np.random.default_rng(0)
+bins = jnp.asarray(rng.integers(0, B, size=(rows, F), dtype=np.uint8))
+g = jnp.asarray(rng.normal(size=rows).astype(np.float32))
+h = jnp.asarray(np.full(rows, 0.25, np.float32))
+rw = jnp.ones(rows, jnp.float32)
+fm = jnp.ones(F, jnp.float32)
+meta = dict(num_bins=jnp.full(F, B, jnp.int32),
+            default_bins=jnp.zeros(F, jnp.int32),
+            nan_bins=jnp.full(F, -1, jnp.int32),
+            is_categorical=jnp.zeros(F, bool),
+            monotone=jnp.zeros(F, jnp.int8))
+sp = SplitParams(lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=100,
+                 min_sum_hessian_in_leaf=100.0, min_gain_to_split=0.0,
+                 max_delta_step=0.0, path_smooth=0.0, cat_smooth=10.0,
+                 cat_l2=10.0, max_cat_to_onehot=4)
+cfg = GrowerConfig(num_leaves=leaves, max_depth=-1, max_bin=B, split=sp,
+                   feature_fraction_bynode=1.0, hist_method="onehot",
+                   hist_chunk_rows=chunk, hist_compact=compact)
+
+
+@jax.jit
+def run(bins, g, h, rw, fm, key):
+    t, na = grow_tree(bins, g, h, rw, fm, **meta, key=key, cfg=cfg)
+    return t.num_leaves, t.leaf_value.sum()
+
+
+key = jax.random.PRNGKey(0)
+t0 = time.perf_counter()
+nl, s = run(bins, g, h, rw, fm, key)
+nl = int(nl)
+print(f"compile+first: {time.perf_counter()-t0:.2f}s num_leaves={nl}")
+for trial in range(3):
+    t0 = time.perf_counter()
+    nl, s = run(bins, g, h, rw, fm, jax.random.PRNGKey(trial))
+    float(s)
+    dt = time.perf_counter() - t0
+    print(f"grow: {dt*1e3:.0f} ms  ({dt/max(int(nl)-1,1)*1e3:.2f} ms/split, {int(nl)} leaves)")

@@ -1,0 +1,120 @@
+"""Time the individual per-split ops 254x inside one dispatch."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops.histogram import build_histogram, gather_rows
+from lightgbm_tpu.ops.split import SplitParams, find_best_split
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+F, B, REP = 28, 256, 254
+rng = np.random.default_rng(0)
+bins = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
+g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+h = jnp.asarray(np.full(N, 0.25, np.float32))
+na = jnp.asarray(rng.integers(0, 255, size=N, dtype=np.int32))
+hist = jnp.asarray(rng.normal(size=(F, B, 3)).astype(np.float32))
+
+
+def timed(name, fn, *args):
+    @jax.jit
+    def many(*a):
+        def body(acc, i):
+            out = fn(i, *a)
+            return acc + out, None
+        acc, _ = jax.lax.scan(body, jnp.float32(0),
+                              jnp.arange(REP, dtype=jnp.int32))
+        return acc
+    float(many(*args))
+    t0 = time.perf_counter()
+    float(many(*args))
+    dt = time.perf_counter() - t0 - 0.09
+    print(f"{name:28s} {dt/REP*1e3:8.3f} ms/iter")
+
+
+# 1. column take + decision chain + node_assign update
+def col_chain(i, bins, na):
+    feat = i % F
+    col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+    in_leaf = na == (i % 255)
+    goes_left = col <= (i % B)
+    na2 = jnp.where(in_leaf & ~goes_left, 255 + i, na)
+    mask = jnp.where(in_leaf & goes_left, 1.0, 0.0)
+    return jnp.sum(mask) + jnp.sum(na2)
+
+
+timed("col+decide+assign", col_chain, bins, na)
+
+
+# 2. compaction gather at cap 8192
+def compact(i, bins, g, h, na):
+    mask = jnp.where(na == (i % 255), 1.0, 0.0)
+    bc, gc, hc, mc = gather_rows(bins, g, h, mask, 8192)
+    return jnp.sum(gc) + jnp.sum(bc.astype(jnp.float32)[:, 0])
+
+
+timed("gather_rows cap=8k", compact, bins, g, h, na)
+
+
+# 3. histogram of 8k compacted rows
+bins8 = bins[:8192]
+g8, h8 = g[:8192], h[:8192]
+m8 = jnp.ones(8192, jnp.float32)
+
+
+def hist8(i, bins8, g8, h8, m8):
+    hh = build_histogram(bins8, g8 + i * 1e-12, h8, m8, B, method="onehot",
+                         chunk_rows=8192)
+    return jnp.sum(hh)
+
+
+timed("hist 8k rows", hist8, bins8, g8, h8, m8)
+
+# 4. find_best_split x2
+sp = SplitParams(lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=100,
+                 min_sum_hessian_in_leaf=100.0, min_gain_to_split=0.0,
+                 max_delta_step=0.0, path_smooth=0.0, cat_smooth=10.0,
+                 cat_l2=10.0, max_cat_to_onehot=4)
+meta = dict(num_bins=jnp.full(F, B, jnp.int32),
+            default_bins=jnp.zeros(F, jnp.int32),
+            nan_bins=jnp.full(F, -1, jnp.int32),
+            is_categorical=jnp.zeros(F, bool),
+            monotone=jnp.zeros(F, jnp.int8))
+fm = jnp.ones(F, jnp.float32)
+
+
+def fbs(i, hist):
+    s1 = find_best_split(hist + i * 1e-12, meta["num_bins"], meta["default_bins"],
+                         meta["nan_bins"], meta["is_categorical"],
+                         meta["monotone"], 0.0, 1000.0, 4000.0, sp, fm)
+    s2 = find_best_split(hist * (1 + i * 1e-12), meta["num_bins"], meta["default_bins"],
+                         meta["nan_bins"], meta["is_categorical"],
+                         meta["monotone"], 0.0, 1000.0, 4000.0, sp, fm)
+    return s1.gain + s2.gain
+
+
+timed("find_best_split x2", fbs, hist)
+
+# 5. hist store slice update (simulating [L,F,B,3] in-place writes)
+store = jnp.zeros((255, F, B, 3), jnp.float32)
+
+
+def store_upd(i, store, hist):
+    s2 = store.at[i % 255].set(hist * i).at[(i + 1) % 255].set(hist)
+    return jnp.sum(s2[i % 255, 0, 0])
+
+
+timed("hist store 2x slice set", store_upd, store, hist)
+
+# 6. full hist at N rows (for comparison)
+def histN(i, bins, g, h):
+    hh = build_histogram(bins, g + i * 1e-12, h, jnp.ones(N, jnp.float32), B,
+                         method="onehot", chunk_rows=8192)
+    return jnp.sum(hh)
+
+
+REP = 10
+timed(f"hist {N} rows", histN, bins, g, h)

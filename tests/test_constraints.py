@@ -1,0 +1,105 @@
+"""Interaction constraints + CEGB (shape of reference
+test_engine.py interaction/cegb tests)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _branch_feature_sets(bst):
+    """For every tree: list of (path feature set, leaf) pairs."""
+    model = bst.dump_model()
+    out = []
+
+    def walk(node, path):
+        if "split_index" in node:
+            p2 = path | {node["split_feature"]}
+            walk(node["left_child"], p2)
+            walk(node["right_child"], p2)
+        else:
+            out.append(path)
+    for ti in model["tree_info"]:
+        if "split_index" in ti["tree_structure"]:
+            walk(ti["tree_structure"], set())
+    return out
+
+
+def test_interaction_constraints(regression_data):
+    X, y, _, _ = regression_data
+    num_features = X.shape[1]
+    groups = [[0, 1, 2], [3, 4, 5, 6, 7]]
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15, "verbose": -1,
+                     "interaction_constraints": groups}, ds, num_boost_round=10)
+    # every root->leaf path must be fully contained in one constraint group
+    for path in _branch_feature_sets(bst):
+        assert (path <= set(groups[0])) or (path <= set(groups[1])), path
+    # training still learns something
+    pred = bst.predict(X)
+    assert np.mean((pred - y) ** 2) < np.var(y)
+
+
+def test_interaction_constraints_string_form():
+    cfg = lgb.Config.from_params({"interaction_constraints": "[0,1,2],[2,3]"})
+    assert cfg.interaction_constraints == [[0, 1, 2], [2, 3]]
+
+
+def test_interaction_constraints_singleton(regression_data):
+    X, y, _, _ = regression_data
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7, "verbose": -1,
+                     "interaction_constraints": [[0]]}, ds, num_boost_round=5)
+    for path in _branch_feature_sets(bst):
+        assert path <= {0}
+
+
+def test_cegb_penalty_split_reduces_leaves(regression_data):
+    X, y, _, _ = regression_data
+    ds = lgb.Dataset(X, label=y)
+    base = lgb.train({"objective": "regression", "num_leaves": 31, "verbose": -1},
+                     ds, num_boost_round=5)
+    pen = lgb.train({"objective": "regression", "num_leaves": 31, "verbose": -1,
+                     "cegb_penalty_split": 1.0}, ds, num_boost_round=5)
+    n_base = sum(t["num_leaves"] for t in base.dump_model()["tree_info"])
+    n_pen = sum(t["num_leaves"] for t in pen.dump_model()["tree_info"])
+    assert n_pen < n_base
+
+
+def test_cegb_coupled_concentrates_features(regression_data):
+    X, y, _, _ = regression_data
+    f = X.shape[1]
+    ds = lgb.Dataset(X, label=y)
+    base = lgb.train({"objective": "regression", "num_leaves": 15, "verbose": -1},
+                     ds, num_boost_round=10)
+    pen = lgb.train({"objective": "regression", "num_leaves": 15, "verbose": -1,
+                     "cegb_penalty_feature_coupled": [5.0] * f},
+                    ds, num_boost_round=10)
+    used_base = int(np.count_nonzero(base.feature_importance("split")))
+    used_pen = int(np.count_nonzero(pen.feature_importance("split")))
+    assert used_pen <= used_base
+
+
+def test_cegb_lazy_trains(regression_data):
+    X, y, _, _ = regression_data
+    f = X.shape[1]
+    ds = lgb.Dataset(X, label=y)
+    pen = lgb.train({"objective": "regression", "num_leaves": 7, "verbose": -1,
+                     "cegb_penalty_feature_lazy": [0.01] * f},
+                    ds, num_boost_round=5)
+    pred = pen.predict(X)
+    assert np.mean((pred - y) ** 2) < np.var(y)
+
+
+def test_cegb_scores_differ(regression_data):
+    """CEGB penalties must actually change the trained model."""
+    X, y, _, _ = regression_data
+    f = X.shape[1]
+    ds = lgb.Dataset(X, label=y)
+    base = lgb.train({"objective": "regression", "num_leaves": 15, "verbose": -1},
+                     ds, num_boost_round=5)
+    for extra in ({"cegb_penalty_split": 0.5},
+                  {"cegb_penalty_feature_coupled": [300.0] * f},
+                  {"cegb_penalty_feature_lazy": [0.5] * f}):
+        pen = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "verbose": -1, **extra}, ds, num_boost_round=5)
+        assert not np.allclose(pen.predict(X), base.predict(X)), extra

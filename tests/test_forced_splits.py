@@ -1,0 +1,97 @@
+"""Forced splits via forcedsplits_filename (reference
+test_engine.py:2203 test_forced_split)."""
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _root_split(bst, tree_index=0):
+    ts = bst.dump_model()["tree_info"][tree_index]["tree_structure"]
+    return ts
+
+
+def test_forced_root_split(regression_data, tmp_path):
+    X, y, _, _ = regression_data
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(json.dumps({"feature": 5, "threshold": 0.0}))
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15, "verbose": -1,
+                     "forcedsplits_filename": str(fpath)}, ds, num_boost_round=3)
+    for t in range(3):
+        root = _root_split(bst, t)
+        assert root["split_feature"] == 5
+        assert abs(root["threshold"] - 0.0) < 0.3   # bin upper bound near 0
+
+
+def test_forced_nested_splits(regression_data, tmp_path):
+    X, y, _, _ = regression_data
+    forced = {"feature": 0, "threshold": 0.0,
+              "left": {"feature": 1, "threshold": 0.5},
+              "right": {"feature": 2, "threshold": -0.5}}
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(json.dumps(forced))
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15, "verbose": -1,
+                     "forcedsplits_filename": str(fpath)}, ds, num_boost_round=2)
+    root = _root_split(bst)
+    assert root["split_feature"] == 0
+    assert root["left_child"].get("split_feature") == 1
+    assert root["right_child"].get("split_feature") == 2
+
+
+def test_forced_split_quality(regression_data, tmp_path):
+    """Forcing a suboptimal root split still trains to reasonable quality."""
+    X, y, _, _ = regression_data
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(json.dumps({"feature": 7, "threshold": 1.0}))
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31, "verbose": -1,
+                     "forcedsplits_filename": str(fpath)}, ds, num_boost_round=20)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.5 * np.var(y)
+
+
+def test_forced_split_invalid_falls_back(regression_data, tmp_path):
+    """A forced split that violates min_data gates is dropped; growth continues."""
+    X, y, _, _ = regression_data
+    # threshold far outside the data range -> empty right child -> invalid
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(json.dumps({"feature": 0, "threshold": 1e9}))
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7, "verbose": -1,
+                     "forcedsplits_filename": str(fpath)}, ds, num_boost_round=2)
+    # tree still grows (natural splits), root is NOT the invalid forced one
+    model = bst.dump_model()
+    assert model["tree_info"][0]["num_leaves"] > 1
+    # the failed forced split must leave NO gap in the node arrays: every
+    # internal node of the dumped structure has a real feature, and the
+    # number of leaves matches internal nodes + 1
+    def count(node):
+        if "split_index" in node:
+            assert node["split_feature"] >= 0
+            l, r = count(node["left_child"]), count(node["right_child"])
+            return (l[0] + r[0] + 1, l[1] + r[1])
+        return (0, 1)
+    for ti in model["tree_info"]:
+        internals, leaves = count(ti["tree_structure"])
+        assert leaves == internals + 1 == ti["num_leaves"]
+
+
+def test_forced_nested_after_failure(regression_data, tmp_path):
+    """A failed forced split must not shift its sibling's leaf numbering."""
+    X, y, _, _ = regression_data
+    forced = {"feature": 0, "threshold": 0.0,
+              "left": {"feature": 1, "threshold": 1e9},   # invalid: empty right
+              "right": {"feature": 2, "threshold": -0.5}}
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(json.dumps(forced))
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15, "verbose": -1,
+                     "forcedsplits_filename": str(fpath)}, ds, num_boost_round=2)
+    root = _root_split(bst)
+    assert root["split_feature"] == 0
+    # the right-subtree forced split must still land on feature 2
+    assert root["right_child"].get("split_feature") == 2

@@ -136,3 +136,71 @@ def test_leaf_output_l1():
     p = _default_params(lambda_l1=5.0)
     assert float(leaf_output(10.0, 10.0, p)) == pytest.approx(-0.5)
     assert float(leaf_output(3.0, 10.0, p)) == pytest.approx(0.0)
+
+
+def test_gather_rows_compaction():
+    from lightgbm_tpu.ops.histogram import build_histogram, gather_rows
+    rng = np.random.default_rng(3)
+    n, f, b = 1000, 5, 16
+    bins = jnp.asarray(rng.integers(0, b, size=(n, f), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=n).astype(np.float32))
+    mask = jnp.asarray((rng.uniform(size=n) < 0.3).astype(np.float32)) * 1.5
+    cap = int(jnp.sum(mask > 0)) + 7
+    bc, gc, hc, mc = gather_rows(bins, g, h, mask, cap)
+    assert bc.shape == (cap, f)
+    # same histogram from the compacted buffer as from the full masked pass
+    full = build_histogram(bins, g, h, mask, b, method="scatter")
+    comp = build_histogram(bc, gc, hc, mc, b, method="scatter")
+    np.testing.assert_allclose(np.asarray(full), np.asarray(comp), atol=1e-4)
+
+
+def test_hist_onehot_matches_scatter():
+    from lightgbm_tpu.ops.histogram import build_histogram
+    rng = np.random.default_rng(4)
+    n, f, b = 3000, 7, 32
+    bins = jnp.asarray(rng.integers(0, b, size=(n, f), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=n).astype(np.float32))
+    mask = jnp.asarray((rng.uniform(size=n) < 0.7).astype(np.float32))
+    a = build_histogram(bins, g, h, mask, b, method="scatter")
+    c = build_histogram(bins, g, h, mask, b, method="onehot", chunk_rows=1024)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-4, atol=1e-3)
+
+
+def test_grower_compaction_parity():
+    """Trees grown with and without adaptive compaction are identical."""
+    from lightgbm_tpu.ops.grower import GrowerConfig, grow_tree
+    from lightgbm_tpu.ops.split import SplitParams
+    rng = np.random.default_rng(5)
+    n, f, b = 4000, 6, 16
+    bins = jnp.asarray(rng.integers(0, b, size=(n, f), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray(np.ones(n, np.float32))
+    meta = dict(
+        num_bins=jnp.full(f, b, jnp.int32),
+        default_bins=jnp.zeros(f, jnp.int32),
+        nan_bins=jnp.full(f, -1, jnp.int32),
+        is_categorical=jnp.zeros(f, bool),
+        monotone=jnp.zeros(f, jnp.int8))
+    sp = SplitParams(lambda_l1=0.0, lambda_l2=1.0, min_data_in_leaf=20,
+                     min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0,
+                     max_delta_step=0.0, path_smooth=0.0, cat_smooth=10.0,
+                     cat_l2=10.0, max_cat_to_onehot=4)
+    base = dict(num_leaves=31, max_depth=-1, max_bin=b, split=sp,
+                feature_fraction_bynode=1.0, hist_method="scatter",
+                hist_chunk_rows=8192)
+    key = jax.random.PRNGKey(0)
+    rw = jnp.ones(n, jnp.float32)
+    fm = jnp.ones(f, jnp.float32)
+    t1, na1 = grow_tree(bins, g, h, rw, fm, **meta, key=key,
+                        cfg=GrowerConfig(**base, hist_compact=False))
+    t2, na2 = grow_tree(bins, g, h, rw, fm, **meta, key=key,
+                        cfg=GrowerConfig(**base, hist_compact=True,
+                                         hist_compact_min_cap=256))
+    assert int(t1.num_leaves) == int(t2.num_leaves)
+    np.testing.assert_array_equal(np.asarray(na1), np.asarray(na2))
+    np.testing.assert_allclose(np.asarray(t1.leaf_value),
+                               np.asarray(t2.leaf_value), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(t1.split_feature),
+                                  np.asarray(t2.split_feature))
