@@ -115,7 +115,8 @@ class GBDT:
         return GrowerConfig(
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth, max_bin=max_bin,
             split=sp, feature_fraction_bynode=cfg.feature_fraction_bynode,
-            hist_method=("scatter" if jax.default_backend() == "cpu" else "onehot"),
+            hist_method={"tpu": "pallas", "cpu": "scatter"}.get(
+                jax.default_backend(), "onehot"),
             hist_chunk_rows=cfg.hist_chunk_rows,
             cegb_split_penalty=cfg.cegb_tradeoff * cfg.cegb_penalty_split,
             hist_compact=cfg.hist_compact,

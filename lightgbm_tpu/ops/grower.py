@@ -26,7 +26,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from .histogram import build_histogram, gather_rows
+from .histogram import build_histogram, gather_rows, unrolled_rank
 from .split import (NEG_INF, SplitParams, SplitResult, find_best_split,
                     leaf_gain, leaf_output, per_feature_gains)
 
@@ -67,7 +67,7 @@ class GrowerConfig(NamedTuple):
     max_bin: int              # histogram width B
     split: SplitParams
     feature_fraction_bynode: float
-    hist_method: str          # 'onehot' | 'scatter'
+    hist_method: str          # 'pallas' (TPU) | 'onehot' | 'scatter'
     hist_chunk_rows: int
     # data-parallel mesh axis: rows are sharded across this axis and the
     # reference's histogram ReduceScatter + global-sum collectives
@@ -217,6 +217,83 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             caps.append(c)
             c *= 4
     caps.append(n)
+
+    # Row-partition mode: maintain a permutation of local rows grouped by
+    # leaf (the TPU analog of the reference's DataPartition index ranges,
+    # data_partition.hpp:21-170).  Per split, only the parent's contiguous
+    # segment is touched: every O(N)-per-split pass (leaf masks, decision
+    # vectors, compaction searches) collapses to O(parent rows), bucketed by
+    # the same capacity ladder.  Disabled for feature/voting parallel modes
+    # (shard decisions there ride full-row vectors) and for CEGB-lazy (its
+    # per-row cost bitset needs leaf masks).
+    use_partition = (cfg.hist_compact and len(caps) > 1
+                     and mode in (None, "data") and cegb_lazy is None)
+
+    def _seg_window(begin, cap):
+        """Clamped cap-sized window covering [begin, begin+cap) and the
+        offset of ``begin`` inside it."""
+        start = jnp.clip(begin, 0, max(n - cap, 0))
+        return start, begin - start
+
+    def partition_segment(perm, begin, rows, feat, thr, dleft, f_is_cat, ok):
+        """Stable-partition the parent leaf's segment of ``perm`` by the
+        split decision.  Returns (perm', nleft) — O(bucket cap) work."""
+        def mk(cap):
+            def br(perm):
+                start, off = _seg_window(begin, cap)
+                seg = jax.lax.dynamic_slice(perm, (start,), (cap,))
+                if n * f < 2 ** 31:
+                    # flat [row*F + feat] gather of the split column
+                    colv = jnp.take(bins.reshape(-1), seg * f + feat)
+                else:
+                    # n*f would overflow the int32 flat index: gather the
+                    # rows, then the (dynamic) column
+                    colv = jnp.take(jnp.take(bins, seg, axis=0), feat, axis=1)
+                colv = colv.astype(jnp.int32)
+                is_miss = (colv == nan_bins[feat]) & (nan_bins[feat] >= 0)
+                gl = jnp.where(f_is_cat, colv == thr,
+                               jnp.where(is_miss, dleft, colv <= thr))
+                ar = jnp.arange(cap, dtype=jnp.int32)
+                valid = (ar >= off) & (ar < off + rows)
+                gl_v = gl & valid
+                nleft = jnp.sum(gl_v.astype(jnp.int32))
+                # stable partition via position scatter (a gather-based
+                # double binary search benched 7x slower: large-array
+                # gathers are the slow primitive on TPU)
+                cl = jnp.cumsum(gl_v.astype(jnp.int32))
+                cr = jnp.cumsum((valid & ~gl).astype(jnp.int32))
+                pos = jnp.where(gl_v, off + cl - 1,
+                                jnp.where(valid, off + nleft + cr - 1, ar))
+                new_seg = jnp.zeros(cap, jnp.int32).at[pos].set(seg)
+                if ok is not None:
+                    new_seg = jnp.where(ok, new_seg, seg)
+                    nleft = jnp.where(ok, nleft, 0)
+                return jax.lax.dynamic_update_slice(perm, new_seg, (start,)), nleft
+            return br
+        idx = jnp.searchsorted(jnp.asarray(caps, jnp.int32), rows)
+        return jax.lax.switch(idx, [mk(c) for c in caps], perm)
+
+    def hist_of_segment(perm, begin, rows):
+        """Histogram over the contiguous leaf segment [begin, begin+rows) of
+        the partition — the hot call replacing full-mask histograms."""
+        def mk(cap):
+            def br(perm):
+                start, off = _seg_window(begin, cap)
+                seg = jax.lax.dynamic_slice(perm, (start,), (cap,))
+                ar = jnp.arange(cap, dtype=jnp.int32)
+                valid = (ar >= off) & (ar < off + rows)
+                m = jnp.where(valid, jnp.take(row_weight, seg), 0.0)
+                return build_histogram(jnp.take(bins, seg, axis=0),
+                                       jnp.take(grad, seg),
+                                       jnp.take(hess, seg), m, B,
+                                       method=cfg.hist_method,
+                                       chunk_rows=cfg.hist_chunk_rows)
+            return br
+        idx = jnp.searchsorted(jnp.asarray(caps, jnp.int32), rows)
+        h = jax.lax.switch(idx, [mk(c) for c in caps], perm)
+        if mode == "data":
+            h = jax.lax.psum(h, axis)
+        return h
 
     def hist_of(mask, nrows=None):
         def full(m):
@@ -384,7 +461,6 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # depth gate for root handled trivially (max_depth >= 1 always allows root)
 
     state = dict(
-        node_assign=jnp.zeros(n, jnp.int32),
         hist=hist_store,
         best=best,
         leaf_depth=jnp.zeros(L, jnp.int32),
@@ -407,6 +483,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         node_count=jnp.zeros(L - 1, jnp.float32),
         num_leaves=jnp.int32(1),
     )
+    if use_partition:
+        state["perm"] = jnp.arange(n, dtype=jnp.int32)
+        state["leaf_begin"] = jnp.zeros(L, jnp.int32)
+        state["leaf_nrows"] = jnp.zeros(L, jnp.int32).at[0].set(n)
+    else:
+        state["node_assign"] = jnp.zeros(n, jnp.int32)
     if interaction_sets is not None:
         state["leaf_branch"] = jnp.zeros((L, f_full), jnp.float32)
     if cegb_coupled is not None:
@@ -486,33 +568,50 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         st_ncount = setw(st["node_count"], j, st["leaf_count"][leaf])
 
         # --- partition rows of this leaf ---
-        if mode == "feature":
-            # only the shard owning the winning feature can decide; it
-            # broadcasts the decision (the reference avoids this because
-            # every rank holds every column — here columns are sharded,
-            # so one [n] psum replaces replicated column storage)
-            local_ix = jnp.clip(feat - f_start, 0, f - 1)
-            owns = (feat >= f_start) & (feat < f_start + f)
-            col = jnp.take(bins, local_ix, axis=1).astype(jnp.int32)
-        else:
-            col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
-        is_miss = (col == nan_bins[feat]) & (nan_bins[feat] >= 0)
-        goes_left = jnp.where(
-            f_is_cat, col == thr,
-            jnp.where(is_miss, dleft, col <= thr))
-        if mode == "feature":
-            goes_left = jax.lax.psum(
-                jnp.where(owns, goes_left.astype(jnp.float32), 0.0),
-                axis) > 0.5
-        in_leaf = st["node_assign"] == leaf
-        node_assign = jnp.where(gate(in_leaf & ~goes_left), new_id,
-                                st["node_assign"])
-
-        # --- child histograms: compute smaller, subtract for larger ---
         left_smaller = b.lc[leaf] <= b.rc[leaf]
-        small_mask = jnp.where(in_leaf & (goes_left == left_smaller),
-                               row_weight, 0.0)
-        small_hist = hist_of(small_mask, jnp.sum(small_mask > 0))
+        if use_partition:
+            # reorder only the parent leaf's segment of the row permutation
+            # (DataPartition::Split, data_partition.hpp): O(parent rows)
+            pbegin = st["leaf_begin"][leaf]
+            prows = st["leaf_nrows"][leaf]
+            perm, nleft = partition_segment(
+                st["perm"], pbegin, prows, feat, thr, dleft, f_is_cat, ok)
+            extra_part = dict(
+                perm=perm,
+                leaf_begin=setw(st["leaf_begin"], new_id, pbegin + nleft),
+                leaf_nrows=setw(setw(st["leaf_nrows"], leaf, nleft),
+                                new_id, prows - nleft))
+            sbegin = jnp.where(left_smaller, pbegin, pbegin + nleft)
+            srows = jnp.where(left_smaller, nleft, prows - nleft)
+            small_hist = hist_of_segment(perm, sbegin, srows)
+            in_leaf = goes_left = None
+        else:
+            if mode == "feature":
+                # only the shard owning the winning feature can decide; it
+                # broadcasts the decision (the reference avoids this because
+                # every rank holds every column — here columns are sharded,
+                # so one [n] psum replaces replicated column storage)
+                local_ix = jnp.clip(feat - f_start, 0, f - 1)
+                owns = (feat >= f_start) & (feat < f_start + f)
+                col = jnp.take(bins, local_ix, axis=1).astype(jnp.int32)
+            else:
+                col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+            is_miss = (col == nan_bins[feat]) & (nan_bins[feat] >= 0)
+            goes_left = jnp.where(
+                f_is_cat, col == thr,
+                jnp.where(is_miss, dleft, col <= thr))
+            if mode == "feature":
+                goes_left = jax.lax.psum(
+                    jnp.where(owns, goes_left.astype(jnp.float32), 0.0),
+                    axis) > 0.5
+            in_leaf = st["node_assign"] == leaf
+            extra_part = dict(node_assign=jnp.where(
+                gate(in_leaf & ~goes_left), new_id, st["node_assign"]))
+
+            # --- child histograms: compute smaller, subtract for larger ---
+            small_mask = jnp.where(in_leaf & (goes_left == left_smaller),
+                                   row_weight, 0.0)
+            small_hist = hist_of(small_mask, jnp.sum(small_mask > 0))
         parent_hist = st["hist"][leaf]
         large_hist = parent_hist - small_hist
         lhist = jnp.where(left_smaller, small_hist, large_hist)
@@ -590,8 +689,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             s = find(hist_c, g, h, c, fmask, 0.0, lo_, hi_, penalty=pen)
             return s._replace(gain=jnp.where(depth_ok, s.gain, NEG_INF))
 
-        lmask = jnp.where(in_leaf & goes_left, rw_pos, 0.0)
-        rmask = jnp.where(in_leaf & ~goes_left, rw_pos, 0.0)
+        if use_partition:
+            # CEGB-lazy (the only penalty needing row masks) is mask-path-only
+            lmask = rmask = None
+        else:
+            lmask = jnp.where(in_leaf & goes_left, rw_pos, 0.0)
+            rmask = jnp.where(in_leaf & ~goes_left, rw_pos, 0.0)
         sl = child_best(lhist, b.lg[leaf], b.lh[leaf], b.lc[leaf],
                         l_lo, l_hi, lmask)
         sr = child_best(rhist, b.rg[leaf], b.rh[leaf], b.rc[leaf],
@@ -600,7 +703,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         return dict(
             **extra,
-            node_assign=node_assign, hist=hist, best=best,
+            **extra_part,
+            hist=hist, best=best,
             leaf_depth=leaf_depth, leaf_value=leaf_value,
             leaf_count=leaf_count, leaf_weight=leaf_weight,
             leaf_sum_g=leaf_sum_g, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
@@ -717,4 +821,19 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         internal_count=state["node_count"],
         num_leaves=state["num_leaves"],
     )
-    return tree, state["node_assign"]
+    if not use_partition:
+        return tree, state["node_assign"]
+
+    # ---- node assignment from the partition (once per tree) ----------------
+    # positions [begin_i, begin_i + nrows_i) belong to leaf i; empty leaves
+    # get out-of-range sentinels so they never match.  Unrolled binary search
+    # over the L sorted begins, then one scatter to row order.
+    begins = jnp.where(state["leaf_nrows"] > 0, state["leaf_begin"],
+                       n + 1 + jnp.arange(L, dtype=jnp.int32))
+    order = jnp.argsort(begins)
+    sorted_begin = begins[order]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    rank = unrolled_rank(sorted_begin, pos, strict=False)
+    leaf_of_pos = jnp.take(order, jnp.maximum(rank - 1, 0))
+    node_assign = jnp.zeros(n, jnp.int32).at[state["perm"]].set(leaf_of_pos)
+    return tree, node_assign

@@ -26,6 +26,20 @@ import jax.numpy as jnp
 def build_histogram(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     mask: jax.Array, max_bin: int, *,
                     method: str = "onehot", chunk_rows: int = 65536) -> jax.Array:
+    """Dispatch over histogram kernels; see module docstring.
+
+    method: 'pallas' (fused VMEM one-hot, TPU), 'onehot' (XLA matmul),
+    'scatter' (XLA scatter-add, CPU tests)."""
+    if method == "pallas":
+        if bins.shape[1] * max_bin <= _PALLAS_MAX_FLAT_BINS:
+            return _hist_pallas(bins, grad, hess, mask, max_bin)
+        method = "onehot"   # too wide for the VMEM-resident accumulator
+    return _build_histogram_xla(bins, grad, hess, mask, max_bin,
+                                method=method, chunk_rows=chunk_rows)
+
+
+def _build_histogram_xla(bins, grad, hess, mask, max_bin, *,
+                         method="onehot", chunk_rows=65536):
     """Compute per-feature (grad, hess, count) histograms over masked rows.
 
     Args:
@@ -88,6 +102,81 @@ def _hist_onehot(bins, grad, hess, mask, max_bin, chunk_rows):
     return hist.reshape(3, f, max_bin).transpose(1, 2, 0)
 
 
+def unrolled_rank(sorted_vals: jax.Array, targets: jax.Array,
+                  strict: bool) -> jax.Array:
+    """Per-target count of entries in ``sorted_vals`` that are ``< target``
+    (strict) or ``<= target``.  A statically-unrolled batched binary search:
+    no while-loop sync overhead, and the probe is clamped so a span reaching
+    past the array can never advance the count (the overshoot bug class)."""
+    m = sorted_vals.shape[0]
+    lo = jnp.zeros(targets.shape, jnp.int32)
+    span = 1 << max(0, (m - 1).bit_length())
+    while span >= 1:
+        idx = lo + span - 1
+        v = jnp.take(sorted_vals, jnp.minimum(idx, m - 1))
+        cmp = (v < targets) if strict else (v <= targets)
+        lo = jnp.where((idx < m) & cmp, lo + span, lo)
+        span >>= 1
+    return lo
+
+
+_PALLAS_BLOCK_ROWS = 512
+# beyond this, the (3, F*B) VMEM-resident accumulator (plus bins + one-hot
+# tiles) no longer fits the ~16MB VMEM budget — fall back to the chunked XLA
+# one-hot path
+_PALLAS_MAX_FLAT_BINS = 512 * 1024
+
+
+def _hist_pallas(bins, grad, hess, mask, max_bin):
+    """Fused one-hot histogram: Pallas TPU kernel.
+
+    The XLA one-hot path materializes the ``[chunk, F*B]`` one-hot in HBM
+    (~235MB per 8k-row pass at F=28, B=256) — pure bandwidth waste.  Here the
+    one-hot lives only as VMEM tiles: each grid step loads a row block's bins
+    + (g, h, m) and accumulates ``gh @ onehot`` per feature into the
+    VMEM-resident output, which every grid step revisits (TPU grid is
+    sequential, so the accumulation is race-free).  This is the analog of the
+    reference's per-workgroup local-memory sub-histograms
+    (``src/treelearner/ocl/histogram256.cl:100``) without the atomics.
+    """
+    from jax.experimental import pallas as pl
+
+    n, f = bins.shape
+    B = max_bin
+    BR = min(_PALLAS_BLOCK_ROWS, max(8, n))
+    pad = (-n) % BR
+    gh = jnp.stack([grad * mask, hess * mask, mask], axis=0).astype(jnp.float32)
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        gh = jnp.pad(gh, ((0, 0), (0, pad)))
+        # padded bin value 0 contributes 0 weight: gh columns are zero there
+    n_blocks = (n + pad) // BR
+
+    def kernel(bins_ref, gh_ref, out_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        b = bins_ref[:].astype(jnp.int32)                     # [BR, F]
+        g = gh_ref[:]                                         # [3, BR]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (BR, B), 1)
+        for fi in range(f):                                   # static unroll
+            onehot = (b[:, fi][:, None] == iota).astype(jnp.float32)
+            out_ref[:, fi * B:(fi + 1) * B] += jax.lax.dot_general(
+                g, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)           # [3, B]
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((3, f * B), jnp.float32),
+        grid=(n_blocks,),
+        in_specs=[pl.BlockSpec((BR, f), lambda i: (i, 0)),
+                  pl.BlockSpec((3, BR), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((3, f * B), lambda i: (0, 0)),
+    )(bins, gh)
+    return out.reshape(3, f, B).transpose(1, 2, 0)
+
+
 def gather_rows(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 mask: jax.Array, cap: int):
     """Compact the rows with ``mask > 0`` into fixed-capacity buffers.
@@ -109,13 +198,7 @@ def gather_rows(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # overhead, so the search is unrolled; scripts/profile_gather.py.)
     cs = jnp.cumsum(active.astype(jnp.int32))
     targets = jnp.arange(1, cap + 1, dtype=jnp.int32)         # [cap]
-    lo = jnp.zeros(cap, jnp.int32)
-    span = 1 << max(0, (n - 1).bit_length())
-    while span >= 1:                                          # static unroll
-        mid = jnp.minimum(lo + span, n) - 1
-        lo = jnp.where(jnp.take(cs, mid) < targets, lo + span, lo)
-        span >>= 1
-    row_ids = jnp.minimum(lo, n - 1)
+    row_ids = jnp.minimum(unrolled_rank(cs, targets, strict=True), n - 1)
     filled = targets <= cs[-1]
     return (jnp.take(bins, row_ids, axis=0),
             jnp.take(grad, row_ids),
