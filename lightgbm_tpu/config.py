@@ -224,6 +224,7 @@ class Config:
 
     # -- dataset (config.h:582-800) --
     linear_tree: bool = False
+    linear_lambda: float = 0.0                # ridge reg for leaf linear models (config.h:383)
     max_bin: int = 255
     max_bin_by_feature: List[int] = field(default_factory=list)
     min_data_in_bin: int = 3
@@ -387,6 +388,17 @@ class Config:
         self.task = {"training": "train", "prediction": "predict", "test": "predict",
                      "refit_tree": "refit"}.get(self.task.lower(), self.task.lower())
 
+        self.monotone_constraints_method = self.monotone_constraints_method.lower()
+        check(self.monotone_constraints_method in ("basic", "intermediate", "advanced"),
+              f"unknown monotone_constraints_method: {self.monotone_constraints_method}")
+        if self.monotone_constraints_method != "basic" and self.monotone_constraints:
+            # basic-mode bounds are the strictest of the three reference modes
+            # (monotone_constraints.hpp), so falling back preserves the
+            # monotonicity guarantee, only losing some split quality
+            Log.warning("monotone_constraints_method=%s is not implemented yet; "
+                        "falling back to 'basic' (constraints still enforced)",
+                        self.monotone_constraints_method)
+            self.monotone_constraints_method = "basic"
         check(self.boosting in BOOSTING_TYPES, f"unknown boosting type: {self.boosting}")
         check(self.tree_learner in TREE_LEARNER_TYPES, f"unknown tree learner: {self.tree_learner}")
         check(self.device_type in DEVICE_TYPES, f"unknown device type: {self.device_type}")

@@ -101,6 +101,9 @@ class Dataset:
         self.feature_names: List[str] = []
         self.reference: Optional["Dataset"] = None
         self._device: Optional[DeviceData] = None
+        # raw feature values, kept only for linear trees (the reference keeps
+        # Dataset::raw_data_ when linear_tree=true, dataset.h:717)
+        self.raw_data: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------------
     @property
@@ -140,6 +143,9 @@ class Dataset:
             self._construct_bin_mappers(data, cats)
 
         self._bin_data(data)
+        if config.linear_tree or (reference is not None
+                                  and reference.raw_data is not None):
+            self.raw_data = np.asarray(data, np.float32)
         md = Metadata(self.num_data)
         self.metadata = md
         if label is not None:

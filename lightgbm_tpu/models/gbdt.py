@@ -120,7 +120,8 @@ class GBDT:
             hist_chunk_rows=cfg.hist_chunk_rows,
             cegb_split_penalty=cfg.cegb_tradeoff * cfg.cegb_penalty_split,
             hist_compact=cfg.hist_compact,
-            hist_compact_min_cap=cfg.hist_compact_min_cap)
+            hist_compact_min_cap=cfg.hist_compact_min_cap,
+            extra_trees=cfg.extra_trees)
 
     # ------------------------------------------------------------------
     # feature-gating state: interaction constraints + CEGB (SURVEY.md §2.4)
@@ -172,6 +173,101 @@ class GBDT:
             if node.get("right"):
                 queue.append((node["right"], 1, idx))
         return tuple(out)
+
+    # ------------------------------------------------------------------
+    # linear trees (linear_tree=true; LinearTreeLearner, SURVEY.md §2.4)
+    @functools.cached_property
+    def _raw_dev(self):
+        if self.train_data.raw_data is None:
+            raise LightGBMError(
+                "linear_tree=true requires the Dataset to keep raw values; "
+                "pass linear_tree in the Dataset params")
+        return jnp.asarray(self.train_data.raw_data)
+
+    def _branch_features(self, tree) -> list:
+        """Per-leaf sorted unique NUMERICAL real feature ids on the
+        root->leaf path (linear_tree_learner.cpp:195-215)."""
+        from ..io.bin import BinType
+        mappers = self.train_data.bin_mappers
+        paths = [[] for _ in range(tree.num_leaves)]
+        stack = [(0, [])]
+        while stack:
+            node, fs = stack.pop()
+            if node < 0:
+                paths[~node] = sorted({
+                    f for f in fs
+                    if mappers[f].bin_type != BinType.CATEGORICAL})
+                continue
+            fs2 = fs + [int(tree.split_feature[node])]
+            stack.append((int(tree.left_child[node]), fs2))
+            stack.append((int(tree.right_child[node]), fs2))
+        return paths
+
+    def _fit_linear_tree(self, tree, node_assign, g, h,
+                         row_weight, is_first_tree: bool):
+        """Fit per-leaf linear models and return device arrays for the score
+        update, or None when constants suffice (first tree)."""
+        nl = tree.num_leaves
+        tree.is_linear = True
+        if is_first_tree:
+            # first tree: constants only (linear_tree_learner.cpp:175-181)
+            tree.leaf_const = np.asarray(tree.leaf_value, np.float64).copy()
+            tree.leaf_coeff = [[] for _ in range(nl)]
+            tree.leaf_features = [[] for _ in range(nl)]
+            return None
+        paths = self._branch_features(tree)
+        L = self._grower_cfg.num_leaves
+        k_raw = max(1, max((len(p) for p in paths), default=1))
+        K = 1 << (k_raw - 1).bit_length()          # pad: fewer recompiles
+        feat_mat = np.full((L, K), -1, np.int32)
+        for i, p in enumerate(paths):
+            feat_mat[i, :len(p)] = p
+        feat_dev = jnp.asarray(feat_mat)
+        coeffs, consts, oks = self._fit_linear_jit(
+            self._raw_dev, g, h, node_assign, row_weight, feat_dev)
+        coeffs = np.asarray(coeffs, np.float64)
+        consts = np.asarray(consts, np.float64)
+        oks = np.asarray(oks)
+        leaf_value = np.asarray(tree.leaf_value, np.float64)
+        tree.leaf_const = np.where(oks[:nl], consts[:nl], leaf_value[:nl])
+        tree.leaf_coeff, tree.leaf_features = [], []
+        for i in range(nl):
+            cs, fs = [], []
+            if oks[i]:
+                for jx, f in enumerate(paths[i]):
+                    c = coeffs[i, jx]
+                    if abs(c) > 1e-35:            # kZeroThreshold prune
+                        cs.append(float(c))
+                        fs.append(int(f))
+            tree.leaf_coeff.append(cs)
+            tree.leaf_features.append(fs)
+        # device views for the score update: failed leaves behave as constants
+        coeff_dev = jnp.asarray(np.where(oks[:, None], coeffs, 0.0), jnp.float32)
+        const_dev = jnp.zeros(L, jnp.float32).at[:nl].set(
+            jnp.asarray(tree.leaf_const, jnp.float32))
+        return coeff_dev, const_dev, feat_dev
+
+    def _valid_raw_dev(self, vi: int):
+        if not hasattr(self, "_vraw_cache"):
+            self._vraw_cache = {}
+        if vi not in self._vraw_cache:
+            vset = self.valid_sets[vi]
+            if vset.raw_data is None:
+                raise LightGBMError(
+                    "linear_tree validation sets must keep raw values")
+            self._vraw_cache[vi] = jnp.asarray(vset.raw_data)
+        return self._vraw_cache[vi]
+
+    @functools.cached_property
+    def _fit_linear_jit(self):
+        from ..ops.linear import fit_leaf_linear
+        lam = self.config.linear_lambda
+        L = self._grower_cfg.num_leaves
+
+        @jax.jit    # retraces per feat_mat width K (power-of-2 padded)
+        def fn(raw, g, h, na, rw, feat_mat):
+            return fit_leaf_linear(raw, g, h, na, rw, feat_mat, L, lam)
+        return fn
 
     def _cegb_vectors(self):
         """(coupled[F_inner]|None, lazy[F_inner]|None), tradeoff-premultiplied."""
@@ -296,6 +392,17 @@ class GBDT:
                 tree_arrays = tree_arrays._replace(
                     leaf_value=jnp.asarray(tree.leaf_value, jnp.float32))
 
+            linear_dev = None
+            if cfg.linear_tree and nl > 1:
+                linear_dev = self._fit_linear_tree(
+                    tree, node_assign, g[k], h[k], row_weight,
+                    is_first_tree=(it == 0))
+            elif cfg.linear_tree:
+                tree.is_linear = True
+                tree.leaf_const = np.asarray(tree.leaf_value, np.float64).copy()
+                tree.leaf_coeff = [[] for _ in range(max(1, nl))]
+                tree.leaf_features = [[] for _ in range(max(1, nl))]
+
             tree.shrink(self.shrinkage_rate)
             # first tree carries the boost-from-average bias (Tree::AddBias);
             # a split-less first tree becomes a constant tree holding the bias
@@ -304,15 +411,32 @@ class GBDT:
                     tree.add_bias(self.init_scores[k])
                 else:
                     tree.leaf_value = np.full_like(tree.leaf_value, self.init_scores[k])
+                    if tree.is_linear:
+                        tree.leaf_const = np.asarray(tree.leaf_value, np.float64).copy()
 
             with global_timer.scope("GBDT::update_score"):
                 delta = tree_arrays.leaf_value * self.shrinkage_rate
-                self._train_score = self._train_score.at[k].add(
-                    jnp.where(nl > 1, delta[node_assign], 0.0))
+                if linear_dev is not None:
+                    from ..ops.linear import linear_leaf_delta
+                    coeff_dev, const_dev, feat_dev = linear_dev
+                    row_delta = linear_leaf_delta(
+                        self._raw_dev, node_assign, coeff_dev, const_dev,
+                        feat_dev, tree_arrays.leaf_value) * self.shrinkage_rate
+                    self._train_score = self._train_score.at[k].add(row_delta)
+                else:
+                    self._train_score = self._train_score.at[k].add(
+                        jnp.where(nl > 1, delta[node_assign], 0.0))
                 for vi, vset in enumerate(self.valid_sets):
                     vleaf = self._predict_leaf_jit(tree_arrays, vset.device_data().bins)
-                    self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
-                        jnp.where(nl > 1, delta[vleaf], 0.0))
+                    if linear_dev is not None:
+                        vraw = self._valid_raw_dev(vi)
+                        vdelta = linear_leaf_delta(
+                            vraw, vleaf, coeff_dev, const_dev, feat_dev,
+                            tree_arrays.leaf_value) * self.shrinkage_rate
+                        self._valid_scores[vi] = self._valid_scores[vi].at[k].add(vdelta)
+                    else:
+                        self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
+                            jnp.where(nl > 1, delta[vleaf], 0.0))
             self.models.append(tree)
             self._device_trees.append(tree_arrays)
             self._tree_weights.append(self.shrinkage_rate)
@@ -459,6 +583,9 @@ class GBDT:
         via ``Tree::TreeSHAP``, ``tree.cpp:887``): per row, per class,
         ``[num_features + 1]`` with the bias (expected value) last."""
         from ..ops.shap import tree_shap, expected_value
+        if any(getattr(t, "is_linear", False) for t in self.models):
+            raise LightGBMError(
+                "pred_contrib (TreeSHAP) is not supported for linear trees")
         X = np.asarray(X, dtype=np.float64)
         if X.ndim == 1:
             X = X.reshape(1, -1)
@@ -505,20 +632,38 @@ class GBDT:
         K = self.num_tree_per_iteration
         self.iter_ = len(self.models) // K
 
-        def warm(dd, score):
+        has_linear = any(getattr(t, "is_linear", False) for t in self.models)
+
+        def warm(dd, score, raw):
             bins_np = np.asarray(dd.bins)
             nan_np = np.asarray(dd.nan_bins)
             s = np.array(score, np.float64)
             for i, t in enumerate(self.models):
-                s[i % K] = s[i % K] + t.predict_binned(bins_np, nan_np)
+                if getattr(t, "is_linear", False):
+                    # linear leaves need raw values (binned midpoints would
+                    # warm the scores away from the model's true predictions)
+                    s[i % K] = s[i % K] + t.predict(raw)
+                else:
+                    s[i % K] = s[i % K] + t.predict_binned(bins_np, nan_np)
             return jnp.asarray(s.astype(np.float32))
+
+        def raw_of(ds):
+            if not has_linear:
+                return None
+            if ds.raw_data is None:
+                raise LightGBMError(
+                    "continued training from a linear-tree model requires "
+                    "the Dataset to keep raw values (pass linear_tree=true)")
+            return np.asarray(ds.raw_data, np.float64)
 
         # the first tree of the previous model already carries its bias;
         # drop this model's own boost-from-average init
-        self._train_score = warm(self._dd, jnp.zeros_like(self._train_score))
+        self._train_score = warm(self._dd, jnp.zeros_like(self._train_score),
+                                 raw_of(self.train_data))
         for vi, vset in enumerate(self.valid_sets):
             self._valid_scores[vi] = warm(vset.device_data(),
-                                          jnp.zeros_like(self._valid_scores[vi]))
+                                          jnp.zeros_like(self._valid_scores[vi]),
+                                          raw_of(vset))
 
     # ------------------------------------------------------------------
     def refit(self, X: np.ndarray, y: np.ndarray, decay_rate: float = 0.9) -> None:
@@ -529,6 +674,9 @@ class GBDT:
         ``new = output*shrinkage``, ``leaf = decay*old + (1-decay)*new``."""
         from ..objective import create_objective
         from ..io.dataset import Metadata
+        if any(getattr(t, "is_linear", False) for t in self.models):
+            raise LightGBMError(
+                "refit is not supported for linear-tree models yet")
         cfg = self.config
         X = np.asarray(X, np.float64)
         n = X.shape[0]

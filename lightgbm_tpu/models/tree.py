@@ -48,6 +48,11 @@ class Tree:
         self.cat_boundaries: List[int] = [0]
         self.cat_threshold: List[int] = []
         self.shrinkage: float = 1.0
+        # linear trees (reference tree.h:49-54): per-leaf linear models
+        self.is_linear: bool = False
+        self.leaf_const: np.ndarray = np.zeros(0, np.float64)     # [L]
+        self.leaf_coeff: List[List[float]] = []                   # per leaf
+        self.leaf_features: List[List[int]] = []                  # real ids
 
     # ------------------------------------------------------------------
     @property
@@ -142,25 +147,26 @@ class Tree:
     def predict(self, X: np.ndarray) -> np.ndarray:
         """Raw-value batch prediction (reference ``Tree::Predict``)."""
         n = X.shape[0]
-        if self.num_leaves <= 1:
+        if self.num_leaves <= 1 and not self.is_linear:
             return np.full(n, self.leaf_value[0] if len(self.leaf_value) else 0.0)
+        leaf = self.predict_leaf_index(X)
+        if not self.is_linear:
+            return self.leaf_value[leaf]
+        # linear leaves: const + coeff·x; NaN in any leaf feature falls back
+        # to the constant leaf value (reference PredictionFunLinear,
+        # tree.cpp:127-136)
         out = np.zeros(n, np.float64)
-        idx = np.arange(n)
-        node = np.zeros(n, np.int64)  # current internal node; ~leaf when done
-        active = np.ones(n, bool)
-        while active.any():
-            cur = node[active]
-            rows = idx[active]
-            feats = self.split_feature[cur]
-            goes_left = np.zeros(len(rows), bool)
-            for j in np.unique(cur):
-                sel = cur == j
-                goes_left[sel] = self._decide(int(j), X[rows[sel], self.split_feature[j]])
-            nxt = np.where(goes_left, self.left_child[cur], self.right_child[cur])
-            node[active] = nxt
-            done = nxt < 0
-            out[rows[done]] = self.leaf_value[~nxt[done]]
-            active[rows[done]] = False
+        for l in np.unique(leaf):
+            sel = leaf == l
+            feats = self.leaf_features[l] if l < len(self.leaf_features) else []
+            if not feats:
+                out[sel] = self.leaf_const[l] if len(self.leaf_const) > l else self.leaf_value[l]
+                continue
+            vals = X[np.ix_(sel, feats)]
+            nan_found = np.isnan(vals).any(axis=1)
+            lin = self.leaf_const[l] + np.nan_to_num(vals) @ np.asarray(
+                self.leaf_coeff[l], np.float64)
+            out[sel] = np.where(nan_found, self.leaf_value[l], lin)
         return out
 
     def predict_binned(self, bins: np.ndarray, nan_bins: np.ndarray) -> np.ndarray:
@@ -226,11 +232,17 @@ class Tree:
         self.leaf_value *= rate
         self.internal_value *= rate
         self.shrinkage *= rate
+        if self.is_linear:
+            self.leaf_const = self.leaf_const * rate
+            self.leaf_coeff = [[c * rate for c in cs] for cs in self.leaf_coeff]
 
     def add_bias(self, val: float) -> None:
         """Reference ``Tree::AddBias`` (``tree.h:212``)."""
         self.leaf_value += val
         self.internal_value += val
+        if self.is_linear:
+            self.leaf_const = self.leaf_const + val
+        self.shrinkage = 1.0
 
     # ------------------------------------------------------------------
     def to_text(self, tree_index: int) -> str:
@@ -262,6 +274,17 @@ class Tree:
         else:
             lines.append("leaf_value=" + "{:.17g}".format(
                 self.leaf_value[0] if len(self.leaf_value) else 0.0))
+        if self.is_linear:
+            # reference linear-tree grammar (Tree::ToString, tree.cpp:375-399)
+            lines.append("is_linear=1")
+            arr("leaf_const", self.leaf_const, "{:.17g}")
+            arr("num_features", [len(f) for f in self.leaf_features])
+            lines.append("leaf_features="
+                         + " ".join(" ".join(str(f) for f in fs)
+                                    for fs in self.leaf_features if fs))
+            lines.append("leaf_coeff="
+                         + " ".join(" ".join("{:.17g}".format(c) for c in cs)
+                                    for cs in self.leaf_coeff if cs))
         lines.append(f"shrinkage={self.shrinkage:g}")
         lines.append("")
         return "\n".join(lines)
@@ -277,9 +300,28 @@ class Tree:
         nl = int(kv.get("num_leaves", 1))
         t = cls(nl)
         t.shrinkage = float(kv.get("shrinkage", 1.0))
+
+        def parse_linear(t):
+            if int(kv.get("is_linear", "0")) == 0:
+                return
+            t.is_linear = True
+            n_leaves = max(1, t.num_leaves)
+            t.leaf_const = (np.array([float(x) for x in kv["leaf_const"].split()])
+                            if "leaf_const" in kv else np.zeros(n_leaves))
+            counts = ([int(x) for x in kv["num_features"].split()]
+                      if "num_features" in kv else [0] * n_leaves)
+            feats_flat = ([int(x) for x in kv.get("leaf_features", "").split()])
+            coefs_flat = ([float(x) for x in kv.get("leaf_coeff", "").split()])
+            t.leaf_features, t.leaf_coeff, o = [], [], 0
+            for c in counts:
+                t.leaf_features.append(feats_flat[o:o + c])
+                t.leaf_coeff.append(coefs_flat[o:o + c])
+                o += c
+
         if nl <= 1:
             if "leaf_value" in kv:
                 t.leaf_value = np.array([float(x) for x in kv["leaf_value"].split()], np.float64)
+            parse_linear(t)
             return t
 
         def get(name, dtype, default=None):
@@ -306,6 +348,7 @@ class Tree:
         if "cat_boundaries" in kv:
             t.cat_boundaries = [int(x) for x in kv["cat_boundaries"].split()]
             t.cat_threshold = [int(x) for x in kv["cat_threshold"].split()]
+        parse_linear(t)
         return t
 
     def to_json(self) -> dict:
@@ -313,10 +356,18 @@ class Tree:
         def node_json(i):
             if i < 0:
                 leaf = ~i
-                return {"leaf_index": int(leaf),
-                        "leaf_value": float(self.leaf_value[leaf]),
-                        "leaf_weight": float(self.leaf_weight[leaf]),
-                        "leaf_count": int(self.leaf_count[leaf])}
+                d = {"leaf_index": int(leaf),
+                     "leaf_value": float(self.leaf_value[leaf]),
+                     "leaf_weight": float(self.leaf_weight[leaf]),
+                     "leaf_count": int(self.leaf_count[leaf])}
+                if self.is_linear:
+                    d["leaf_const"] = (float(self.leaf_const[leaf])
+                                       if len(self.leaf_const) > leaf else 0.0)
+                    d["leaf_features"] = list(self.leaf_features[leaf]) \
+                        if leaf < len(self.leaf_features) else []
+                    d["leaf_coeff"] = list(self.leaf_coeff[leaf]) \
+                        if leaf < len(self.leaf_coeff) else []
+                return d
             return {
                 "split_index": int(i),
                 "split_feature": int(self.split_feature[i]),
@@ -331,6 +382,6 @@ class Tree:
                 "right_child": node_json(int(self.right_child[i])),
             }
         return {"num_leaves": int(self.num_leaves), "num_cat": len(self.cat_boundaries) - 1,
-                "shrinkage": self.shrinkage,
+                "shrinkage": self.shrinkage, "is_linear": int(self.is_linear),
                 "tree_structure": node_json(0) if self.num_leaves > 1 else
                 {"leaf_value": float(self.leaf_value[0]) if len(self.leaf_value) else 0.0}}

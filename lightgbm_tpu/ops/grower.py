@@ -90,6 +90,9 @@ class GrowerConfig(NamedTuple):
     # sibling's rows into the tightest power-of-4 bucket before histogramming
     hist_compact: bool = True
     hist_compact_min_cap: int = 8192
+    # extremely-randomized trees: one random threshold per feature per node
+    # (reference USE_RAND, feature_histogram.hpp:115-217)
+    extra_trees: bool = False
 
 
 class TreeArrays(NamedTuple):
@@ -332,8 +335,17 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         thresh = jax.lax.top_k(u, n_take)[0][-1]
         return jnp.where(u >= thresh, feature_mask, 0.0)
 
+    def rand_thresholds(step):
+        """extra_trees: one random valid numeric threshold per (node, feature)."""
+        if not cfg.extra_trees:
+            return None
+        k = jax.random.fold_in(jax.random.fold_in(key, 7919), step)
+        hi = jnp.maximum(num_bins_l - 2 - (nan_bins_l >= 0), 0)
+        u = jax.random.uniform(k, (num_bins_l.shape[0],))
+        return jnp.floor(u * (hi + 1).astype(jnp.float32)).astype(jnp.int32)
+
     def find(hist, sum_g, sum_h, count, fmask, parent_output=0.0,
-             lo=NEG_INF, hi=-NEG_INF, penalty=None):
+             lo=NEG_INF, hi=-NEG_INF, penalty=None, rand=None):
         """Mode-dispatched best-split search (the analog of the reference's
         learner-specific FindBestSplitsFromHistograms overrides)."""
         if mode == "feature":
@@ -342,20 +354,20 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                      if penalty is not None else None)
             s = find_best_split(hist, num_bins_l, default_bins_l, nan_bins_l,
                                 is_cat_l, mono_l, sum_g, sum_h, count, p,
-                                fmask_l, parent_output, lo, hi, pen_l)
+                                fmask_l, parent_output, lo, hi, pen_l, rand)
             # local winner carries a shard-local feature id; globalize and
             # allreduce-max the packed SplitInfo (parallel_tree_learner.h:191)
             s = s._replace(feature=s.feature + f_start)
             return _reduce_split_global(s, axis)
         if mode == "voting":
             return _find_voting(hist, sum_g, sum_h, count, fmask,
-                                parent_output, lo, hi, penalty)
+                                parent_output, lo, hi, penalty, rand)
         return find_best_split(hist, num_bins_l, default_bins_l, nan_bins_l,
                                is_cat_l, mono_l, sum_g, sum_h, count, p,
-                               fmask, parent_output, lo, hi, penalty)
+                               fmask, parent_output, lo, hi, penalty, rand)
 
     def _find_voting(hist, sum_g, sum_h, count, fmask, parent_output, lo, hi,
-                     penalty=None):
+                     penalty=None, rand=None):
         """Local top-k proposal → global vote → reduce only elected
         histograms (voting_parallel_tree_learner.cpp:151-345)."""
         # local gains with min-data/hessian gates scaled to the shard
@@ -382,7 +394,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         emask = jnp.where(fmask > 0, emask, 0.0)
         return find_best_split(hist_e, num_bins_l, default_bins_l, nan_bins_l,
                                is_cat_l, mono_l, sum_g, sum_h, count, p,
-                               emask, parent_output, lo, hi, penalty)
+                               emask, parent_output, lo, hi, penalty, rand)
 
     use_cegb = (cegb_coupled is not None or cegb_lazy is not None
                 or cfg.cegb_split_penalty > 0.0)
@@ -454,7 +466,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             rw_pos, tot[2],
             jnp.zeros(f_full, bool) if cegb_coupled is not None else None,
             cegb_used_data)
-    root_split = find(root_hist, tot[0], tot[1], tot[2], fmask0, penalty=pen0)
+    root_split = find(root_hist, tot[0], tot[1], tot[2], fmask0, penalty=pen0,
+                      rand=rand_thresholds(0))
 
     hist_store = jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist)
     best = _BestSplits.empty(L).set_leaf(0, root_split)
@@ -682,11 +695,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # --- new best splits for both children ---
         depth_ok = (cfg.max_depth <= 0) | (depth < cfg.max_depth)
 
+        rand = rand_thresholds(j + 1)
+
         def child_best(hist_c, g, h, c, lo_, hi_, mask_c):
             pen = None
             if use_cegb:
                 pen = cegb_penalty(mask_c, c, feat_used, used_data)
-            s = find(hist_c, g, h, c, fmask, 0.0, lo_, hi_, penalty=pen)
+            s = find(hist_c, g, h, c, fmask, 0.0, lo_, hi_, penalty=pen,
+                     rand=rand)
             return s._replace(gain=jnp.where(depth_ok, s.gain, NEG_INF))
 
         if use_partition:

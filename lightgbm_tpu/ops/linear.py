@@ -1,0 +1,84 @@
+"""Leaf-wise linear model fitting for linear trees (``linear_tree=true``).
+
+TPU-native re-design of the reference's ``LinearTreeLearner::CalculateLinear``
+(``src/treelearner/linear_tree_learner.cpp:170-380``): per leaf, a ridge
+regression of the Newton step on the raw values of the leaf's branch features
+— coefficients ``-(XᵀHX + λI)⁻¹ Xᵀg`` (Eq. 3 of arXiv:1802.05640), rows with
+NaN in any branch feature excluded.  The reference accumulates per-thread
+triangular XᵀHX buffers and solves with vendored Eigen; here each leaf's
+normal equations are built with masked matmuls and solved with a batched
+``jnp.linalg.solve`` over a ``lax.map`` of leaves.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fit_leaf_linear(raw: jax.Array, grad: jax.Array, hess: jax.Array,
+                    node_assign: jax.Array, row_weight: jax.Array,
+                    feat_mat: jax.Array, num_leaves: int,
+                    linear_lambda: float):
+    """Fit per-leaf linear models.
+
+    Args:
+      raw: ``[n, F_total]`` raw feature values (may contain NaN).
+      grad, hess: ``[n]`` f32.
+      node_assign: ``[n]`` i32 leaf of each row.
+      row_weight: ``[n]`` f32 (0 = bagged out).
+      feat_mat: ``[L, K]`` i32 real-feature ids on each leaf's branch path,
+        -1 padded.
+      linear_lambda: ridge term (applied to feature dims, not the intercept —
+        linear_tree_learner.cpp:343).
+
+    Returns (coeffs [L, K] f64, consts [L] f64, ok [L] bool) — ``ok`` is the
+    reference's non-NaN-row-count gate (rows >= num_feats + 1).
+    """
+    n, _ = raw.shape
+    L, K = feat_mat.shape
+
+    def one(l):
+        feats = feat_mat[l]
+        fvalid = feats >= 0
+        cols = jnp.where(fvalid, feats, 0)
+        Xl = jnp.take(raw, cols, axis=1)                       # [n, K]
+        row_nan = jnp.any(jnp.isnan(Xl) & fvalid[None, :], axis=1)
+        w = ((node_assign == l) & (row_weight > 0) & ~row_nan)
+        wf = w.astype(jnp.float32)
+        Xa = jnp.concatenate(
+            [jnp.where(fvalid[None, :], jnp.nan_to_num(Xl), 0.0),
+             jnp.ones((n, 1), jnp.float32)], axis=1)           # [n, K+1]
+        Xw = Xa * wf[:, None]
+        XTHX = (Xw * hess[:, None]).T @ Xw                     # [K+1, K+1]
+        XTg = Xw.T @ (grad * wf)
+        # ridge on feature dims; unit diag on padded dims keeps the system
+        # nonsingular (their rows are zero, so their coefficients solve to 0)
+        diag = jnp.concatenate(
+            [jnp.where(fvalid, linear_lambda, 1.0), jnp.zeros(1)])
+        # tiny jitter on active dims guards exact singularity (the reference's
+        # fullPivLu inverse of a singular system is equally meaningless and
+        # gated by `ok` below)
+        A = XTHX + jnp.diag(diag.astype(jnp.float32)) + 1e-10 * jnp.eye(K + 1)
+        beta = -jnp.linalg.solve(A, XTg)
+        nnz = jnp.sum(w)
+        ok = nnz >= (jnp.sum(fvalid) + 1)
+        return beta[:K], beta[K], ok
+
+    coeffs, consts, oks = jax.lax.map(one, jnp.arange(L, dtype=jnp.int32))
+    return coeffs, consts, oks
+
+
+def linear_leaf_delta(raw: jax.Array, leaf: jax.Array,
+                      coeffs: jax.Array, consts: jax.Array,
+                      feat_mat: jax.Array, fallback: jax.Array) -> jax.Array:
+    """Per-row linear leaf output: ``const[leaf] + Σ coef·x``; rows with NaN
+    in any of their leaf's features take ``fallback[leaf]`` (the constant
+    leaf value — reference ``PredictionFunLinear``, tree.cpp:127-136)."""
+    feats = feat_mat[leaf]                                     # [n, K]
+    fvalid = feats >= 0
+    cols = jnp.where(fvalid, feats, 0)
+    vals = jnp.take_along_axis(raw, cols, axis=1)              # [n, K]
+    nan_found = jnp.any(jnp.isnan(vals) & fvalid, axis=1)
+    lin = consts[leaf] + jnp.sum(
+        jnp.where(fvalid, coeffs[leaf] * jnp.nan_to_num(vals), 0.0), axis=1)
+    return jnp.where(nan_found, fallback[leaf], lin)

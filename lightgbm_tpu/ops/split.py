@@ -98,7 +98,7 @@ def leaf_gain(sum_g, sum_h, p: SplitParams, parent_output=0.0, count=None,
 def _split_gain_matrix(hist, num_bins, nan_bins, is_categorical, monotone,
                        total, p: SplitParams, feature_mask,
                        parent_output, output_lo, output_hi,
-                       gain_penalty=None):
+                       gain_penalty=None, rand_threshold=None):
     """Candidate gains over all (feature, threshold) pairs.
 
     Returns (gain_fb [F, B], use_left [F, B], cum [F, B, 3], miss [F, 3]).
@@ -147,6 +147,12 @@ def _split_gain_matrix(hist, num_bins, nan_bins, is_categorical, monotone,
         # serial_tree_learner.cpp:740-744)
         gain_fb = jnp.where(gain_fb > NEG_INF / 2,
                             gain_fb - gain_penalty[:, None], gain_fb)
+    if rand_threshold is not None:
+        # extra_trees: each feature offers exactly ONE random threshold
+        # (reference USE_RAND specialization, feature_histogram.hpp:115-217);
+        # categorical features keep the full scan like the reference
+        keep = (bin_ids == rand_threshold[:, None]) | is_cat
+        gain_fb = jnp.where(keep, gain_fb, NEG_INF)
     gain_fb = jnp.where(feature_mask[:, None] > 0, gain_fb, NEG_INF)
     return gain_fb, use_left, cum, miss
 
@@ -170,7 +176,7 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
                     monotone: jax.Array, sum_g, sum_h, count,
                     p: SplitParams, feature_mask: jax.Array,
                     parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF,
-                    gain_penalty=None) -> SplitResult:
+                    gain_penalty=None, rand_threshold=None) -> SplitResult:
     """Find the best split of a leaf given its histogram.
 
     Args:
@@ -185,7 +191,8 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
     total = jnp.stack([sum_g, sum_h, count]).astype(jnp.float32)       # [3]
     gain_fb, use_left, cum, miss = _split_gain_matrix(
         hist, num_bins, nan_bins, is_categorical, monotone, total, p,
-        feature_mask, parent_output, output_lo, output_hi, gain_penalty)
+        feature_mask, parent_output, output_lo, output_hi, gain_penalty,
+        rand_threshold)
 
     # --- argmax over (feature, threshold) ------------------------------------
     flat = gain_fb.reshape(-1)

@@ -250,3 +250,30 @@ def test_record_and_reset_lr(binary_data):
                         learning_rate=lambda i: 0.1 * (0.99 ** i))],
                     evals_result=evals, verbose_eval=False)
     assert len(evals["valid_0"]["auc"]) == 10
+
+
+def test_extra_trees(regression_data):
+    import numpy as np
+    X, y, _, _ = regression_data
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1}
+    b0 = lgb.train(base, lgb.Dataset(X, label=y), 10)
+    b1 = lgb.train(dict(base, extra_trees=True), lgb.Dataset(X, label=y), 10)
+    # randomized thresholds -> different model, still learns
+    assert not np.allclose(b0.predict(X), b1.predict(X))
+    assert np.mean((b1.predict(X) - y) ** 2) < np.var(y)
+
+
+def test_monotone_method_fallback(regression_data):
+    import numpy as np
+    X, y, _, _ = regression_data
+    f = X.shape[1]
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+              "monotone_constraints": [1] + [0] * (f - 1),
+              "monotone_constraints_method": "advanced"}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params), 10)
+    # monotonicity must hold along feature 0 regardless of method
+    base = np.median(X, axis=0)
+    grid = np.tile(base, (50, 1))
+    grid[:, 0] = np.linspace(X[:, 0].min(), X[:, 0].max(), 50)
+    pred = bst.predict(grid)
+    assert (np.diff(pred) >= -1e-10).all()

@@ -1,0 +1,122 @@
+"""Linear trees (linear_tree=true; reference LinearTreeLearner,
+test_engine.py linear-tree tests)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def piecewise_linear_data():
+    """Data where leaves have strong linear structure: y = x0 * sign-regions."""
+    rng = np.random.default_rng(11)
+    n = 3000
+    X = rng.uniform(-3, 3, size=(n, 4))
+    y = np.where(X[:, 1] > 0, 3.0 * X[:, 0] + 1.0, -2.0 * X[:, 0] - 1.0)
+    y += 0.05 * rng.normal(size=n)
+    return X, y
+
+
+def test_linear_tree_beats_constant(piecewise_linear_data):
+    X, y = piecewise_linear_data
+    params = {"objective": "regression", "num_leaves": 4, "verbose": -1,
+              "learning_rate": 0.5, "min_data_in_leaf": 50}
+    const = lgb.train(params, lgb.Dataset(X, label=y, params=params), 10)
+    lp = dict(params, linear_tree=True)
+    linear = lgb.train(lp, lgb.Dataset(X, label=y, params=lp), 10)
+    mse_c = np.mean((const.predict(X) - y) ** 2)
+    mse_l = np.mean((linear.predict(X) - y) ** 2)
+    # piecewise-linear target: linear leaves should be far better
+    assert mse_l < 0.5 * mse_c, (mse_l, mse_c)
+
+
+def test_linear_tree_model_roundtrip(piecewise_linear_data, tmp_path):
+    X, y = piecewise_linear_data
+    params = {"objective": "regression", "num_leaves": 5, "verbose": -1,
+              "linear_tree": True}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params), 5)
+    p = bst.predict(X)
+    f = tmp_path / "linear.txt"
+    bst.save_model(str(f))
+    assert "is_linear=1" in f.read_text()
+    bst2 = lgb.Booster(model_file=str(f))
+    np.testing.assert_allclose(bst2.predict(X), p, rtol=1e-6, atol=1e-6)
+
+
+def test_linear_tree_nan_fallback(piecewise_linear_data):
+    X, y = piecewise_linear_data
+    params = {"objective": "regression", "num_leaves": 4, "verbose": -1,
+              "linear_tree": True}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params), 5)
+    Xn = X.copy()
+    Xn[:50, 0] = np.nan
+    p = bst.predict(Xn)
+    assert np.isfinite(p).all()
+
+
+def test_linear_tree_valid_eval(piecewise_linear_data):
+    X, y = piecewise_linear_data
+    params = {"objective": "regression", "metric": "l2", "num_leaves": 4,
+              "verbose": -1, "linear_tree": True}
+    ds = lgb.Dataset(X[:2500], label=y[:2500], params=params)
+    vs = ds.create_valid(X[2500:], label=y[2500:])
+    evals = {}
+    bst = lgb.train(params, ds, 10, valid_sets=[vs], valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    l2 = evals["v"]["l2"]
+    assert l2[-1] < l2[0]
+    # recorded valid metric must match a fresh prediction
+    pred = bst.predict(X[2500:])
+    assert abs(np.mean((pred - y[2500:]) ** 2) - l2[-1]) < 1e-4
+
+
+def test_linear_tree_sklearn(piecewise_linear_data):
+    X, y = piecewise_linear_data
+    reg = lgb.LGBMRegressor(n_estimators=8, num_leaves=4, linear_tree=True,
+                            verbose=-1)
+    reg.fit(X, y)
+    assert np.mean((reg.predict(X) - y) ** 2) < np.var(y)
+
+
+def test_linear_tree_continued_training(piecewise_linear_data, tmp_path):
+    X, y = piecewise_linear_data
+    params = {"objective": "regression", "num_leaves": 4, "verbose": -1,
+              "linear_tree": True}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst1 = lgb.train(params, ds, 5)
+    f = tmp_path / "m.txt"
+    bst1.save_model(str(f))
+    ds2 = lgb.Dataset(X, label=y, params=params)
+    bst2 = lgb.train(params, ds2, 5, init_model=str(f))
+    mse1 = np.mean((bst1.predict(X) - y) ** 2)
+    mse2 = np.mean((bst2.predict(X) - y) ** 2)
+    assert mse2 < mse1   # continued training must improve from correct scores
+
+
+def test_linear_tree_contrib_and_refit_raise(piecewise_linear_data):
+    X, y = piecewise_linear_data
+    params = {"objective": "regression", "num_leaves": 4, "verbose": -1,
+              "linear_tree": True}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params), 3)
+    with pytest.raises(lgb.LightGBMError):
+        bst.predict(X, pred_contrib=True)
+    with pytest.raises(lgb.LightGBMError):
+        bst.refit(X, y)
+
+
+def test_linear_tree_json_has_coeffs(piecewise_linear_data):
+    X, y = piecewise_linear_data
+    params = {"objective": "regression", "num_leaves": 4, "verbose": -1,
+              "linear_tree": True}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params), 3)
+    ti = bst.dump_model()["tree_info"]
+    assert any(t.get("is_linear") for t in ti)
+
+    def leaves(node, out):
+        if "split_index" in node:
+            leaves(node["left_child"], out); leaves(node["right_child"], out)
+        else:
+            out.append(node)
+    out = []
+    leaves(ti[-1]["tree_structure"], out)
+    assert any("leaf_coeff" in l and l["leaf_coeff"] for l in out)
