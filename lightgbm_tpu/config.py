@@ -309,7 +309,9 @@ class Config:
     # DataPartition index ranges) instead of full-dataset masking
     hist_compact: bool = True
     hist_compact_min_cap: int = 8192          # smallest gather bucket
+    hist_compact_ladder: int = 2              # bucket growth factor (2 or 4)
     mesh_shape: List[int] = field(default_factory=list)   # device mesh, [] = all devices on one axis
+    pred_device: str = "auto"                 # auto | device | host ensemble predict
     donate_state: bool = True
 
     # unknown keys seen during parsing (kept for model-file round trip)

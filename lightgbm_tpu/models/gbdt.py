@@ -96,6 +96,34 @@ class GBDT:
                 init[k] += s
         self._train_score = jnp.asarray(init)
         self._grower_cfg = self._make_grower_cfg()
+        self._setup_parallel()
+
+    def _setup_parallel(self) -> None:
+        """Route ``tree_learner=data|feature|voting`` through a device mesh
+        (the analog of the reference's learner×device ``CreateTreeLearner``
+        factory, ``tree_learner.cpp:15-53``).  Falls back to serial with a
+        warning when only one device is available."""
+        from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS, default_mesh
+        cfg = self.config
+        self._mesh = None
+        tl = cfg.tree_learner or "serial"
+        if tl == "serial":
+            return
+        n_dev = cfg.mesh_shape[0] if cfg.mesh_shape else len(jax.devices())
+        if n_dev < 2:
+            Log.warning(
+                "tree_learner=%s requested but only one device is available; "
+                "training serially", tl)
+            return
+        if cfg.forcedsplits_filename and tl in ("feature", "voting"):
+            raise LightGBMError(
+                "forced splits are not supported with the feature/voting "
+                "parallel tree learners")
+        axis = FEATURE_AXIS if tl == "feature" else DATA_AXIS
+        self._mesh = default_mesh(n_dev, axis_name=axis)
+        self._grower_cfg = self._grower_cfg._replace(
+            axis_name=axis, parallel_mode=tl, num_shards=n_dev,
+            top_k=cfg.top_k)
 
     def _make_grower_cfg(self) -> GrowerConfig:
         cfg = self.config
@@ -121,6 +149,7 @@ class GBDT:
             cegb_split_penalty=cfg.cegb_tradeoff * cfg.cegb_penalty_split,
             hist_compact=cfg.hist_compact,
             hist_compact_min_cap=cfg.hist_compact_min_cap,
+            hist_compact_ladder=cfg.hist_compact_ladder,
             extra_trees=cfg.extra_trees)
 
     # ------------------------------------------------------------------
@@ -463,14 +492,98 @@ class GBDT:
         inter = self._interaction_sets()
         _, lazy = self._cegb_vectors()
         forced = self._forced_splits()
+        mesh = getattr(self, "_mesh", None)
+
+        if mesh is None:
+            @jax.jit
+            def fn(bins, g, h, rw, fmask, key, cegb_coupled, cegb_used):
+                return grow_tree(bins, g, h, rw, fmask, dd.num_bins,
+                                 dd.default_bins, dd.nan_bins,
+                                 dd.is_categorical, dd.monotone, key, cfg,
+                                 interaction_sets=inter,
+                                 cegb_coupled=cegb_coupled,
+                                 cegb_lazy=lazy, cegb_used_data=cegb_used,
+                                 forced=forced)
+            return fn
+
+        # parallel learners: the same grow_tree program under shard_map, with
+        # rows (data/voting) or features (feature) sharded over the mesh and
+        # the grower's psum/pmax collectives joining the shards (reference
+        # learner dataflows: data_parallel_tree_learner.cpp:155-251,
+        # feature_parallel_tree_learner.cpp:38-57,
+        # voting_parallel_tree_learner.cpp:151-345)
+        from jax.sharding import PartitionSpec as P
+        axis = cfg.axis_name
+        ns = cfg.num_shards
+        n = self.train_data.num_data
+        f = self.train_data.num_features
+
+        if cfg.parallel_mode == "feature":
+            f_pad = (-f) % ns
+            pad_i = lambda a, v: jnp.pad(a, (0, f_pad), constant_values=v)
+            num_bins = pad_i(dd.num_bins, 1)
+            default_bins = pad_i(dd.default_bins, 0)
+            nan_bins = pad_i(dd.nan_bins, -1)
+            is_cat = pad_i(dd.is_categorical, False)
+            mono = pad_i(dd.monotone, 0)
+            inter_p = (jnp.pad(inter, ((0, 0), (0, f_pad)))
+                       if inter is not None else None)
+            lazy_p = pad_i(lazy, 0.0) if lazy is not None else None
+
+            def grow(bins, g, h, rw, fmask, key, cc, cu):
+                return grow_tree(bins, g, h, rw, fmask, num_bins, default_bins,
+                                 nan_bins, is_cat, mono, key, cfg,
+                                 interaction_sets=inter_p, cegb_coupled=cc,
+                                 cegb_lazy=lazy_p, cegb_used_data=cu)
+
+            sharded = jax.shard_map(
+                grow, mesh=mesh,
+                in_specs=(P(None, axis), P(), P(), P(), P(), P(), P(), P()),
+                out_specs=(P(), P()), check_vma=False)
+
+            @jax.jit
+            def fn(bins, g, h, rw, fmask, key, cegb_coupled, cegb_used):
+                if f_pad:
+                    bins = jnp.pad(bins, ((0, 0), (0, f_pad)))
+                    fmask = jnp.pad(fmask, (0, f_pad))
+                    if cegb_coupled is not None:
+                        cegb_coupled = jnp.pad(cegb_coupled, (0, f_pad))
+                    if cegb_used is not None:
+                        cegb_used = jnp.pad(cegb_used, ((0, 0), (0, f_pad)))
+                return sharded(bins, g, h, rw, fmask, key,
+                               cegb_coupled, cegb_used)
+            return fn
+
+        # data / voting: rows sharded
+        n_pad = (-n) % ns
+
+        def grow(bins, g, h, rw, fmask, key, cc, cu):
+            return grow_tree(bins, g, h, rw, fmask, dd.num_bins,
+                             dd.default_bins, dd.nan_bins, dd.is_categorical,
+                             dd.monotone, key, cfg, interaction_sets=inter,
+                             cegb_coupled=cc, cegb_lazy=lazy,
+                             cegb_used_data=cu, forced=forced)
+
+        sharded = jax.shard_map(
+            grow, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P(), P(),
+                      P(axis)),
+            out_specs=(P(), P(axis)), check_vma=False)
 
         @jax.jit
         def fn(bins, g, h, rw, fmask, key, cegb_coupled, cegb_used):
-            return grow_tree(bins, g, h, rw, fmask, dd.num_bins, dd.default_bins,
-                             dd.nan_bins, dd.is_categorical, dd.monotone, key, cfg,
-                             interaction_sets=inter, cegb_coupled=cegb_coupled,
-                             cegb_lazy=lazy, cegb_used_data=cegb_used,
-                             forced=forced)
+            if n_pad:
+                # pad rows to a mesh multiple; zero weight excludes them from
+                # every histogram/sum, so results match serial exactly
+                bins = jnp.pad(bins, ((0, n_pad), (0, 0)))
+                g = jnp.pad(g, (0, n_pad))
+                h = jnp.pad(h, (0, n_pad))
+                rw = jnp.pad(rw, (0, n_pad))
+                if cegb_used is not None:
+                    cegb_used = jnp.pad(cegb_used, ((0, n_pad), (0, 0)))
+            tree, na = sharded(bins, g, h, rw, fmask, key,
+                               cegb_coupled, cegb_used)
+            return tree, (na[:n] if n_pad else na)
         return fn
 
     def _cegb_state(self):
@@ -550,9 +663,18 @@ class GBDT:
         return out
 
     # ------------------------------------------------------------------
+    # row*tree volume above which the stacked device traversal beats the
+    # host loop (compile cost amortizes); overridable via config.pred_device
+    _DEVICE_PREDICT_MIN_WORK = 2_000_000
+
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
                     start_iteration: int = 0) -> np.ndarray:
-        """Raw scores [N] or [N, K] (reference ``GBDT::PredictRaw``)."""
+        """Raw scores [N] or [N, K] (reference ``GBDT::PredictRaw``).
+
+        Large requests run as ONE compiled device program over the stacked
+        ensemble (``ops/ensemble.py``) instead of a per-tree host loop —
+        the TPU analog of the reference's OpenMP block predictor
+        (``gbdt_prediction.cpp:20-72``)."""
         X = np.asarray(X, dtype=np.float64)
         if X.ndim == 1:
             X = X.reshape(1, -1)
@@ -560,13 +682,38 @@ class GBDT:
         n_iters = len(self.models) // K
         if num_iteration is not None and num_iteration > 0:
             n_iters = min(n_iters, num_iteration)
-        out = np.zeros((X.shape[0], K))
-        for i in range(start_iteration, start_iteration + n_iters):
-            for k in range(K):
-                ti = i * K + k
-                if ti < len(self.models):
-                    out[:, k] += self.models[ti].predict(X)
+        models = self.models[start_iteration * K:(start_iteration + n_iters) * K]
+
+        mode = getattr(self.config, "pred_device", "auto")
+        use_device = models and mode != "host" and (
+            mode == "device"
+            or X.shape[0] * len(models) >= self._DEVICE_PREDICT_MIN_WORK)
+        if use_device:
+            out = self._predict_raw_device(models, start_iteration, X)
+        else:
+            out = np.zeros((X.shape[0], K))
+            for ti, t in enumerate(models):
+                out[:, ti % K] += t.predict(X)
         return out[:, 0] if K == 1 else out
+
+    def _predict_raw_device(self, models, start_iteration: int,
+                            X: np.ndarray) -> np.ndarray:
+        from ..ops.ensemble import predict_raw_ensemble, stack_trees
+        key = (start_iteration, len(models), len(self.models))
+        cache = getattr(self, "_ens_cache", None)
+        if cache is None or cache[0] != key:
+            self._ens_cache = (key, stack_trees(models))
+        ens = self._ens_cache[1]
+        K = self.num_tree_per_iteration
+        any_linear = any(getattr(t, "is_linear", False) for t in models)
+        fn = jax.jit(predict_raw_ensemble, static_argnums=(2, 3))
+        out = np.zeros((X.shape[0], K))
+        step = 1 << 22                      # bound device residency of X
+        for s in range(0, X.shape[0], step):
+            chunk = jnp.asarray(X[s:s + step], jnp.float32)
+            out[s:s + step] = np.asarray(fn(ens, chunk, K, any_linear),
+                                         np.float64).T
+        return out
 
     def predict(self, X: np.ndarray, num_iteration: int = -1,
                 start_iteration: int = 0, raw_score: bool = False) -> np.ndarray:
@@ -629,6 +776,7 @@ class GBDT:
         self.models = [copy.deepcopy(t) for t in prev.models]
         self._tree_weights = list(prev._tree_weights) or [1.0] * len(self.models)
         self._device_trees = []
+        self._ens_cache = None
         K = self.num_tree_per_iteration
         self.iter_ = len(self.models) // K
 
@@ -721,6 +869,7 @@ class GBDT:
                                 + (1.0 - decay_rate) * new_out)
                 score[k] += t.leaf_value[lp].astype(np.float32)
         self._device_trees = []            # host trees changed; drop caches
+        self._ens_cache = None
 
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
@@ -734,6 +883,7 @@ class GBDT:
         self.models = self.models[:-K]
         self._device_trees = self._device_trees[:-K]
         self._tree_weights = self._tree_weights[:-K]
+        self._ens_cache = None
         self.iter_ -= 1
         self._train_score, self._valid_scores = self._prev_scores
         self._prev_scores = None

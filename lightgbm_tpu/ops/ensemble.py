@@ -1,0 +1,208 @@
+"""Device batched ensemble prediction over raw feature values.
+
+Replaces the per-tree host loop for ``GBDT::PredictRaw`` (reference
+``src/boosting/gbdt_prediction.cpp:20-72``, per-row ``Tree::Predict``
+recursion ``tree.h:133``) with ONE compiled program: every tree's flat arrays
+are stacked into ``[T, ...]`` device tensors and a ``lax.scan`` over trees
+runs a vectorized ``while_loop`` traversal for all rows at once.
+
+Exactness: raw inputs are compared in float32.  Each f64 node threshold ``t``
+is rounded DOWN to the nearest f32 (``nextafter`` if the cast rounded up), so
+for any f32-representable input ``x``: ``x <= t  <=>  f32(x) <= t32`` — the
+device decision matches the host f64 decision exactly for f32 data (the
+common case; f64 inputs with sub-f32 resolution may differ at the ulp).
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.common import K_ZERO_THRESHOLD
+
+_MT_NONE, _MT_ZERO, _MT_NAN = 0, 1, 2
+
+
+class EnsembleArrays(NamedTuple):
+    """Stacked flat trees (device layout of ``List[Tree]``)."""
+    split_feature: jax.Array    # [T, M] i32 real feature ids
+    threshold: jax.Array        # [T, M] f32 (f32-down-rounded reals)
+    is_cat: jax.Array           # [T, M] bool
+    default_left: jax.Array     # [T, M] bool
+    missing_type: jax.Array     # [T, M] i32
+    left_child: jax.Array       # [T, M] i32 (~leaf encoding)
+    right_child: jax.Array      # [T, M] i32
+    leaf_value: jax.Array       # [T, L] f32
+    has_split: jax.Array        # [T] bool
+    # categorical bitsets, flattened across all trees
+    cat_lo: jax.Array           # [T, M] i32 word offset into cat_words
+    cat_nwords: jax.Array       # [T, M] i32
+    cat_words: jax.Array        # [W] u32
+    # linear trees (K=1 zero-filled when no linear trees in the slice;
+    # whether to apply them is the STATIC any_linear argument of
+    # predict_raw_ensemble, kept out of this pytree so jit doesn't trace it)
+    leaf_const: jax.Array       # [T, L] f32
+    leaf_coeff: jax.Array       # [T, L, K] f32
+    leaf_feats: jax.Array       # [T, L, K] i32 (-1 = unused)
+
+
+def _f32_down(t: np.ndarray) -> np.ndarray:
+    """Largest f32 <= t (so f32 compares reproduce the f64 decision)."""
+    t32 = t.astype(np.float32)
+    up = t32.astype(np.float64) > t
+    return np.where(up, np.nextafter(t32, np.float32(-np.inf)), t32)
+
+
+def stack_trees(models: List) -> EnsembleArrays:
+    """Stack host ``Tree`` objects into device arrays (pad to max sizes)."""
+    T = len(models)
+    M = max(1, max(t.num_internal for t in models))
+    L = max(1, max(t.num_leaves for t in models))
+    sf = np.zeros((T, M), np.int32)
+    th = np.zeros((T, M), np.float32)
+    ic = np.zeros((T, M), bool)
+    dl = np.zeros((T, M), bool)
+    mt = np.zeros((T, M), np.int32)
+    lc = np.full((T, M), -1, np.int32)
+    rc = np.full((T, M), -1, np.int32)
+    lv = np.zeros((T, L), np.float32)
+    hs = np.zeros(T, bool)
+    clo = np.zeros((T, M), np.int32)
+    cnw = np.zeros((T, M), np.int32)
+    words: List[int] = []
+    any_linear = any(getattr(t, "is_linear", False) for t in models)
+    K = 1
+    if any_linear:
+        K = max([1] + [len(fs) for t in models if t.is_linear
+                       for fs in t.leaf_features])
+    const = np.zeros((T, L), np.float32)
+    coeff = np.zeros((T, L, K), np.float32)
+    feats = np.full((T, L, K), -1, np.int32)
+
+    for ti, t in enumerate(models):
+        m = t.num_internal if t.num_leaves > 1 else 0
+        hs[ti] = t.num_leaves > 1
+        if m:
+            sf[ti, :m] = t.split_feature[:m]
+            lc[ti, :m] = t.left_child[:m]
+            rc[ti, :m] = t.right_child[:m]
+            for j in range(m):
+                if t.is_categorical_split(j):
+                    ic[ti, j] = True
+                    cidx = int(t.threshold[j])
+                    lo, hi = t.cat_boundaries[cidx], t.cat_boundaries[cidx + 1]
+                    clo[ti, j] = len(words)
+                    cnw[ti, j] = hi - lo
+                    words.extend(int(w) for w in t.cat_threshold[lo:hi])
+                else:
+                    th[ti, j] = _f32_down(np.float64(t.threshold[j]))
+                    dl[ti, j] = t.default_left(j)
+                    mt[ti, j] = t.missing_type(j)
+        nl = max(1, t.num_leaves)
+        lv[ti, :nl] = t.leaf_value[:nl] if len(t.leaf_value) >= nl else 0.0
+        if any_linear and getattr(t, "is_linear", False):
+            ncl = min(nl, len(t.leaf_const))
+            const[ti, :ncl] = t.leaf_const[:ncl]
+            for li in range(min(nl, len(t.leaf_features))):
+                fs, cs = t.leaf_features[li], t.leaf_coeff[li]
+                feats[ti, li, :len(fs)] = fs
+                coeff[ti, li, :len(cs)] = cs
+        elif any_linear:
+            const[ti, :nl] = lv[ti, :nl]
+
+    return EnsembleArrays(
+        split_feature=jnp.asarray(sf), threshold=jnp.asarray(th),
+        is_cat=jnp.asarray(ic), default_left=jnp.asarray(dl),
+        missing_type=jnp.asarray(mt),
+        left_child=jnp.asarray(lc), right_child=jnp.asarray(rc),
+        leaf_value=jnp.asarray(lv), has_split=jnp.asarray(hs),
+        cat_lo=jnp.asarray(clo), cat_nwords=jnp.asarray(cnw),
+        cat_words=jnp.asarray(np.asarray(words or [0], np.uint32)),
+        leaf_const=jnp.asarray(const), leaf_coeff=jnp.asarray(coeff),
+        leaf_feats=jnp.asarray(feats))
+
+
+def predict_leaf_raw(ens: EnsembleArrays, X: jax.Array, ti) -> jax.Array:
+    """Leaf index per row of raw-valued ``X [N, F]`` for tree ``ti``."""
+    n = X.shape[0]
+    sf = ens.split_feature[ti]
+    th = ens.threshold[ti]
+    ic = ens.is_cat[ti]
+    dl = ens.default_left[ti]
+    mt = ens.missing_type[ti]
+    lch = ens.left_child[ti]
+    rch = ens.right_child[ti]
+    clo = ens.cat_lo[ti]
+    cnw = ens.cat_nwords[ti]
+    words = ens.cat_words
+
+    def cond(cur):
+        return jnp.any(cur >= 0)
+
+    def body(cur):
+        node = jnp.maximum(cur, 0)
+        feat = sf[node]
+        x = jnp.take_along_axis(X, feat[:, None], axis=1)[:, 0]
+        is_nan = jnp.isnan(x)
+        x0 = jnp.where(is_nan, 0.0, x)
+        node_mt = mt[node]
+        is_miss = jnp.where(
+            node_mt == _MT_ZERO,
+            is_nan | (jnp.abs(x) <= K_ZERO_THRESHOLD),
+            jnp.where(node_mt == _MT_NAN, is_nan, False))
+        numeric = jnp.where(is_miss, dl[node], x0 <= th[node])
+        # categorical bitset membership (reference Tree::CategoricalDecision)
+        iv = jnp.where(jnp.isfinite(x) & (x >= 0), x, -1.0).astype(jnp.int32)
+        wi = iv // 32
+        in_range = (iv >= 0) & (wi < cnw[node])
+        widx = jnp.clip(clo[node] + wi, 0, words.shape[0] - 1)
+        bit = (words[widx] >> (iv % 32).astype(jnp.uint32)) & 1
+        cat_left = in_range & (bit == 1)
+        goes_left = jnp.where(ic[node], cat_left, numeric)
+        nxt = jnp.where(goes_left, lch[node], rch[node])
+        return jnp.where(cur >= 0, nxt, cur)
+
+    init = jnp.where(ens.has_split[ti],
+                     jnp.zeros(n, jnp.int32), jnp.full(n, -1, jnp.int32))
+    final = jax.lax.while_loop(cond, body, init)
+    return (~final).astype(jnp.int32)
+
+
+def predict_raw_ensemble(ens: EnsembleArrays, X: jax.Array,
+                         num_class: int, any_linear: bool = False) -> jax.Array:
+    """Summed raw scores ``[K, N]`` over all stacked trees (trees are
+    interleaved per class: tree ``t`` belongs to class ``t % K``).
+
+    Accumulation is float32 with Kahan compensation, so the sum over trees
+    carries ~1 ulp of error vs the host loop's float64 accumulation (for
+    in-session models the leaf values themselves are exactly f32)."""
+    T = ens.leaf_value.shape[0]
+    n = X.shape[0]
+    K = num_class
+
+    def body(carry, ti):
+        acc, comp = carry
+        leaf = predict_leaf_raw(ens, X, ti)
+        delta = ens.leaf_value[ti][leaf]
+        if any_linear:
+            lin = ens.leaf_const[ti][leaf]
+            fs = ens.leaf_feats[ti][leaf]                    # [N, Kc]
+            cs = ens.leaf_coeff[ti][leaf]                    # [N, Kc]
+            used = fs >= 0
+            xv = jnp.take_along_axis(X, jnp.maximum(fs, 0), axis=1)
+            nan_found = jnp.any(used & jnp.isnan(xv), axis=1)
+            lin = lin + jnp.sum(jnp.where(used, jnp.nan_to_num(xv) * cs, 0.0),
+                                axis=1)
+            delta = jnp.where(nan_found, delta, lin)
+        k = ti % K
+        y = delta - comp[k]
+        t = acc[k] + y
+        comp_k = (t - acc[k]) - y
+        return (acc.at[k].set(t), comp.at[k].set(comp_k)), None
+
+    zero = jnp.zeros((K, n), jnp.float32)
+    (acc, _), _ = jax.lax.scan(body, (zero, zero),
+                               jnp.arange(T, dtype=jnp.int32))
+    return acc

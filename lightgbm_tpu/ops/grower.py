@@ -90,6 +90,9 @@ class GrowerConfig(NamedTuple):
     # sibling's rows into the tightest power-of-4 bucket before histogramming
     hist_compact: bool = True
     hist_compact_min_cap: int = 8192
+    # capacity-ladder growth factor: 2 halves average bucket round-up waste
+    # vs 4 at the cost of ~2x more switch branches to compile
+    hist_compact_ladder: int = 2
     # extremely-randomized trees: one random threshold per feature per node
     # (reference USE_RAND, feature_histogram.hpp:115-217)
     extra_trees: bool = False
@@ -218,7 +221,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         c = min(cfg.hist_compact_min_cap, n)
         while c < n:
             caps.append(c)
-            c *= 4
+            c *= max(2, cfg.hist_compact_ladder)
     caps.append(n)
 
     # Row-partition mode: maintain a permutation of local rows grouped by
@@ -518,10 +521,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            st["leaf_count"][leaf]])
         bin_ids = jnp.arange(B)
         miss_b = nan_bins[feat]
+        # numeric: missing rows go LEFT, matching the reference's forced-split
+        # gather which excludes the NaN bin from the RIGHT accumulation and
+        # sets default_left=true (GatherInfoForThresholdNumericalInner,
+        # feature_histogram.hpp)
         num_left = jnp.sum(
-            jnp.where(((bin_ids <= thr) & (bin_ids != miss_b))[:, None], h, 0.0),
-            axis=0)                                                  # missing -> right
-        left = jnp.where(is_categorical[feat], h[thr], num_left)
+            jnp.where(((bin_ids <= thr) | (bin_ids == miss_b))[:, None], h, 0.0),
+            axis=0)
+        f_cat = is_categorical[feat]
+        left = jnp.where(f_cat, h[thr], num_left)
         right = total - left
         lo, hi = st["leaf_lo"][leaf], st["leaf_hi"][leaf]
         lout = leaf_output(left[0], left[1], p, 0.0, left[2], lo, hi)
@@ -529,13 +537,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         gain = (leaf_gain(left[0], left[1], p, 0.0, left[2], lo, hi)
                 + leaf_gain(right[0], right[1], p, 0.0, right[2], lo, hi)
                 - leaf_gain(total[0], total[1], p, 0.0, total[2], lo, hi))
-        ok = ((left[2] >= p.min_data_in_leaf) & (right[2] >= p.min_data_in_leaf)
-              & (left[1] >= p.min_sum_hessian_in_leaf)
-              & (right[1] >= p.min_sum_hessian_in_leaf) & (gain > 0))
+        # the reference gates forced splits only on the gain threshold
+        # (min_gain_to_split), not on min-data/min-hessian
+        ok = gain > p.min_gain_to_split
         return SplitResult(
             gain=jnp.where(ok, gain, NEG_INF),
             feature=jnp.int32(feat), threshold=jnp.int32(thr),
-            default_left=jnp.asarray(False),
+            default_left=~f_cat,
             left_sum_g=left[0], left_sum_h=left[1], left_count=left[2],
             right_sum_g=right[0], right_sum_h=right[1], right_count=right[2],
             left_output=lout, right_output=rout)
@@ -673,8 +681,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         if cegb_coupled is not None:
             # the coupled penalty is paid once per feature per model: mark
             # it used and refund the penalty in other leaves' cached best
-            # gains that proposed the same feature (the reference's
-            # UpdateLeafBestSplits correction)
+            # gains that proposed the same feature.  This approximates the
+            # reference's UpdateLeafBestSplits: leaves whose cached best used
+            # a DIFFERENT feature are not re-searched here, so a refunded
+            # feature that would now overtake a leaf's cached best is missed
+            # until that leaf is next split (the reference re-runs the search
+            # for such leaves)
             refund = jnp.where(st["feat_used"][feat], 0.0, cegb_coupled[feat])
             cur_best = cur_best._replace(gain=jnp.where(
                 gate((cur_best.feature == feat)

@@ -1,0 +1,56 @@
+"""Bench + verify the bf16 split-precision Pallas histogram vs the f32 paths.
+
+Run on the TPU (ambient axon backend):
+    PYTHONPATH=/root/.axon_site:/root/repo python scripts/bench_hist2.py [rows]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops.histogram import _hist_onehot, _hist_pallas
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+F = int(sys.argv[2]) if len(sys.argv) > 2 else 28
+B = int(sys.argv[3]) if len(sys.argv) > 3 else 255
+
+rng = np.random.default_rng(0)
+bins = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
+g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+h = jnp.asarray(rng.uniform(0.1, 1, size=N).astype(np.float32))
+m = jnp.ones(N, jnp.float32)
+
+
+def timed(name, fn, iters=10):
+    @jax.jit
+    def many(bins, g, h, m):
+        def body(acc, i):
+            hh = fn(bins, g + i * 1e-12, h, m)
+            return acc + jnp.sum(hh), None
+        acc, _ = jax.lax.scan(body, jnp.float32(0),
+                              jnp.arange(iters, dtype=jnp.float32))
+        return acc
+
+    float(many(bins, g, h, m))
+    t0 = time.perf_counter()
+    float(many(bins, g, h, m))
+    dt = (time.perf_counter() - t0 - 0.09) / iters
+    rate = N / dt / 1e9
+    print(f"{name:28s} {dt*1e3:8.2f} ms  {rate:6.2f} Grow/s")
+    return dt
+
+
+ref = jax.jit(lambda b, g, h, m: _hist_onehot(b, g, h, m, B, 65536))(
+    bins[:65536], g[:65536], h[:65536], m[:65536])
+got = jax.jit(lambda b, g, h, m: _hist_pallas(b, g, h, m, B))(
+    bins[:65536], g[:65536], h[:65536], m[:65536])
+err = float(jnp.max(jnp.abs(ref - got) / (jnp.abs(ref) + 1.0)))
+print(f"pallas-vs-onehot max rel err: {err:.2e}")
+assert err < 1e-4, err
+
+for br in (512, 1024, 2048):
+    timed(f"pallas bf16 BR={br}",
+          lambda b, g, h, m, br=br: _hist_pallas(b, g, h, m, B, block_rows=br))
+timed("onehot f32 (xla)", lambda b, g, h, m: _hist_onehot(b, g, h, m, B, 65536))

@@ -1,0 +1,111 @@
+"""Device stacked-ensemble prediction must match the host per-tree loop
+exactly on f32 data (reference parity target: GBDT::PredictRaw,
+gbdt_prediction.cpp:20-72)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _train(X, y, params, rounds=12):
+    p = {"verbose": -1, "num_leaves": 15, "min_data_in_leaf": 5, "seed": 3}
+    p.update(params)
+    ds = lgb.Dataset(X, label=y, params=p)
+    return lgb.train(p, ds, num_boost_round=rounds)
+
+
+def _host_device(bst, X, **kw):
+    g = bst._gbdt
+    g.config.pred_device = "host"
+    host = bst.predict(X, **kw)
+    g.config.pred_device = "device"
+    g._ens_cache = None
+    dev = bst.predict(X, **kw)
+    g.config.pred_device = "auto"
+    return host, dev
+
+
+def test_device_predict_binary_nan():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(800, 6)).astype(np.float32).astype(np.float64)
+    X[rng.random(X.shape) < 0.1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0).astype(float)
+    bst = _train(X, y, {"objective": "binary"})
+    host, dev = _host_device(bst, X)
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_device_predict_zero_as_missing():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(600, 5))
+    X[rng.random(X.shape) < 0.3] = 0.0
+    X = X.astype(np.float32).astype(np.float64)
+    y = (X[:, 0] + X[:, 2] > 0).astype(float)
+    bst = _train(X, y, {"objective": "binary", "zero_as_missing": True,
+                        "use_missing": True})
+    host, dev = _host_device(bst, X)
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_device_predict_categorical():
+    rng = np.random.default_rng(2)
+    n = 900
+    Xc = rng.integers(0, 40, size=(n, 2)).astype(np.float64)
+    Xn = rng.normal(size=(n, 3)).astype(np.float32).astype(np.float64)
+    X = np.column_stack([Xc, Xn])
+    y = ((Xc[:, 0] % 3 == 0) | (Xn[:, 0] > 1)).astype(float)
+    bst = _train(X, y, {"objective": "binary",
+                        "categorical_feature": [0, 1],
+                        "max_cat_to_onehot": 1})
+    host, dev = _host_device(bst, X)
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_device_predict_multiclass_and_slicing():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(900, 8)).astype(np.float32).astype(np.float64)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    bst = _train(X, y, {"objective": "multiclass", "num_class": 3})
+    host, dev = _host_device(bst, X)
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+    host, dev = _host_device(bst, X, num_iteration=4, start_iteration=2)
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_device_predict_linear_tree():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(700, 4)).astype(np.float32).astype(np.float64)
+    y = 2.0 * X[:, 0] + np.sin(X[:, 1]) + 0.05 * rng.normal(size=700)
+    bst = _train(X, y, {"objective": "regression", "linear_tree": True})
+    host, dev = _host_device(bst, X)
+    np.testing.assert_allclose(dev, host, rtol=0, atol=1e-6)
+
+
+def test_device_predict_from_model_file(tmp_path):
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(600, 5)).astype(np.float32).astype(np.float64)
+    y = (X[:, 0] - X[:, 3] > 0).astype(float)
+    bst = _train(X, y, {"objective": "binary"})
+    f = tmp_path / "m.txt"
+    bst.save_model(str(f))
+    loaded = lgb.Booster(model_file=str(f))
+    host, dev = _host_device(loaded, X)
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_auto_heuristic_routes_large_to_device(monkeypatch):
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(500, 4)).astype(np.float32).astype(np.float64)
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train(X, y, {"objective": "binary"}, rounds=5)
+    g = bst._gbdt
+    calls = {}
+    orig = type(g)._predict_raw_device
+
+    def spy(self, *a, **k):
+        calls["device"] = True
+        return orig(self, *a, **k)
+    monkeypatch.setattr(type(g), "_predict_raw_device", spy)
+    monkeypatch.setattr(type(g), "_DEVICE_PREDICT_MIN_WORK", 1000)
+    bst.predict(X)
+    assert calls.get("device")
