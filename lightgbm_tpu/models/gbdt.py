@@ -139,7 +139,9 @@ class GBDT:
             max_delta_step=cfg.max_delta_step,
             path_smooth=cfg.path_smooth,
             cat_smooth=cfg.cat_smooth, cat_l2=cfg.cat_l2,
-            max_cat_to_onehot=cfg.max_cat_to_onehot)
+            max_cat_to_onehot=cfg.max_cat_to_onehot,
+            max_cat_threshold=cfg.max_cat_threshold,
+            min_data_per_group=cfg.min_data_per_group)
         return GrowerConfig(
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth, max_bin=max_bin,
             split=sp, feature_fraction_bynode=cfg.feature_fraction_bynode,
@@ -786,6 +788,11 @@ class GBDT:
             bins_np = np.asarray(dd.bins)
             nan_np = np.asarray(dd.nan_bins)
             s = np.array(score, np.float64)
+            for t in self.models:
+                if len(t.cat_boundaries) > 1:
+                    # text-loaded trees carry VALUE bitsets only; binned
+                    # traversal needs the bin-space ones
+                    t.bin_cat_bitsets(self.train_data.bin_mappers)
             for i, t in enumerate(self.models):
                 if getattr(t, "is_linear", False):
                     # linear leaves need raw values (binned midpoints would

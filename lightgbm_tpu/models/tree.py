@@ -47,6 +47,9 @@ class Tree:
         # categorical split support: threshold indexes into cat bitset arrays
         self.cat_boundaries: List[int] = [0]
         self.cat_threshold: List[int] = []
+        # BIN-space bitsets per cat node (for binned traversal); rebuilt from
+        # the value bitsets via bin_cat_bitsets() for text-loaded models
+        self.cat_bits_bin: dict = {}
         self.shrinkage: float = 1.0
         # linear trees (reference tree.h:49-54): per-leaf linear models
         self.is_linear: bool = False
@@ -94,6 +97,7 @@ class Tree:
 
         is_cat = np.asarray(arrays.is_cat_split[:m], bool)
         dleft = np.asarray(arrays.default_left[:m], bool)
+        cat_bits = np.asarray(arrays.cat_bits[:m], np.int32).view(np.uint32)
         t.threshold = np.zeros(m, np.float64)
         t.decision_type = np.zeros(m, np.int8)
         for j in range(m):
@@ -101,12 +105,18 @@ class Tree:
             dt = 0
             if is_cat[j]:
                 dt |= _CAT_MASK
-                # one-hot category: bitset with the single chosen category
-                cat = mapper.bin_to_value(int(t.threshold_bin[j]))
+                # bin bitset -> category-VALUE bitset (reference
+                # Tree::SplitCategorical stores cat_threshold over raw values)
+                words = cat_bits[j]
+                bins_set = [bi for bi in range(32 * len(words))
+                            if (words[bi // 32] >> (bi % 32)) & 1]
+                t.cat_bits_bin[j] = words.copy()
+                cats = sorted(int(mapper.bin_to_value(bi)) for bi in bins_set)
                 t.threshold[j] = float(len(t.cat_boundaries) - 1)  # cat index
-                word_cnt = int(cat) // 32 + 1
+                word_cnt = (max(cats) // 32 + 1) if cats else 1
                 bits = [0] * word_cnt
-                bits[int(cat) // 32] |= 1 << (int(cat) % 32)
+                for cat in cats:
+                    bits[cat // 32] |= 1 << (cat % 32)
                 t.cat_threshold.extend(bits)
                 t.cat_boundaries.append(len(t.cat_threshold))
             else:
@@ -169,6 +179,26 @@ class Tree:
             out[sel] = np.where(nan_found, self.leaf_value[l], lin)
         return out
 
+    def bin_cat_bitsets(self, mappers) -> None:
+        """Rebuild BIN-space bitsets from the value bitsets so binned
+        traversal works for text-loaded models (inverse of the
+        ``from_arrays`` bin->value mapping)."""
+        for j in range(self.num_internal):
+            if not self.is_categorical_split(j) or j in self.cat_bits_bin:
+                continue
+            mapper = mappers[self.split_feature[j]]
+            ci = int(self.threshold[j])
+            lo, hi = self.cat_boundaries[ci], self.cat_boundaries[ci + 1]
+            words_vals = np.array(self.cat_threshold[lo:hi], np.uint32)
+            nb = mapper.num_bin
+            out = np.zeros((nb + 31) // 32, np.uint32)
+            for bi in range(nb):
+                v = int(mapper.bin_to_value(bi))
+                if 0 <= v < 32 * len(words_vals) and \
+                        (int(words_vals[v // 32]) >> (v % 32)) & 1:
+                    out[bi // 32] |= np.uint32(1 << (bi % 32))
+            self.cat_bits_bin[j] = out
+
     def predict_binned(self, bins: np.ndarray, nan_bins: np.ndarray) -> np.ndarray:
         """Batch prediction over BINNED columns (inner feature space), using
         the grower's decision convention (``ops/grower.py`` partition step).
@@ -191,7 +221,15 @@ class Tree:
                 col = bins[rows[sel], fi].astype(np.int64)
                 thr = int(self.threshold_bin[j])
                 if self.is_categorical_split(j):
-                    goes_left[sel] = col == thr
+                    words = self.cat_bits_bin.get(j)
+                    if words is None:
+                        goes_left[sel] = col == thr      # legacy one-hot
+                    else:
+                        wi = (col >> 5).astype(np.int64)
+                        ok_w = wi < len(words)
+                        w = words[np.clip(wi, 0, len(words) - 1)]
+                        goes_left[sel] = ok_w & (
+                            ((w >> (col % 32).astype(np.uint32)) & 1) == 1)
                 else:
                     nb = int(nan_bins[fi])
                     is_miss = (col == nb) & (nb >= 0)

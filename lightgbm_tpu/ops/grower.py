@@ -27,8 +27,9 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import build_histogram, gather_rows, unrolled_rank
-from .split import (NEG_INF, SplitParams, SplitResult, find_best_split,
-                    leaf_gain, leaf_output, per_feature_gains)
+from .split import (NEG_INF, SplitParams, SplitResult, bitset_contains,
+                    cat_words, find_best_split, leaf_gain, leaf_output,
+                    pack_bin_bitset, per_feature_gains)
 
 
 def _reduce_split_global(s: SplitResult, axis_name: str) -> SplitResult:
@@ -45,6 +46,13 @@ def _reduce_split_global(s: SplitResult, axis_name: str) -> SplitResult:
     mine = (dev == winner)
 
     def bc(x):
+        if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == bool:
+            # integer payloads (ids, bitsets) ride an exact integer psum —
+            # a float cast would corrupt bitset words above 2^24
+            xi = x.astype(jnp.int32)
+            out = jax.lax.psum(jnp.where(mine, xi, jnp.zeros_like(xi)),
+                               axis_name)
+            return out.astype(x.dtype)
         xf = x.astype(jnp.float32)
         out = jax.lax.psum(jnp.where(mine, xf, jnp.zeros_like(xf)), axis_name)
         return out.astype(x.dtype) if x.dtype != jnp.float32 else out
@@ -52,12 +60,13 @@ def _reduce_split_global(s: SplitResult, axis_name: str) -> SplitResult:
     return SplitResult(
         gain=gain_max,
         feature=bc(s.feature), threshold=bc(s.threshold),
-        default_left=bc(s.default_left.astype(jnp.int32)).astype(bool),
+        default_left=bc(s.default_left),
         left_sum_g=bc(s.left_sum_g), left_sum_h=bc(s.left_sum_h),
         left_count=bc(s.left_count),
         right_sum_g=bc(s.right_sum_g), right_sum_h=bc(s.right_sum_h),
         right_count=bc(s.right_count),
-        left_output=bc(s.left_output), right_output=bc(s.right_output))
+        left_output=bc(s.left_output), right_output=bc(s.right_output),
+        cat_bits=bc(s.cat_bits))
 
 
 class GrowerConfig(NamedTuple):
@@ -108,6 +117,7 @@ class TreeArrays(NamedTuple):
     threshold: jax.Array       # [L-1] i32 bin threshold
     default_left: jax.Array    # [L-1] bool
     is_cat_split: jax.Array    # [L-1] bool
+    cat_bits: jax.Array        # [L-1, CW] i32 bin-bitset for cat splits
     split_gain: jax.Array      # [L-1] f32
     left_child: jax.Array      # [L-1] i32
     right_child: jax.Array     # [L-1] i32
@@ -126,14 +136,16 @@ class _BestSplits(NamedTuple):
     lg: jax.Array; lh: jax.Array; lc: jax.Array
     rg: jax.Array; rh: jax.Array; rc: jax.Array
     lout: jax.Array; rout: jax.Array
+    cat_bits: jax.Array       # [n, CW] i32
 
     @classmethod
-    def empty(cls, n: int) -> "_BestSplits":
+    def empty(cls, n: int, cw: int) -> "_BestSplits":
         z = jnp.zeros(n, jnp.float32)
         return cls(gain=jnp.full(n, NEG_INF, jnp.float32),
                    feature=jnp.zeros(n, jnp.int32), threshold=jnp.zeros(n, jnp.int32),
                    default_left=jnp.zeros(n, bool),
-                   lg=z, lh=z, lc=z, rg=z, rh=z, rc=z, lout=z, rout=z)
+                   lg=z, lh=z, lc=z, rg=z, rh=z, rc=z, lout=z, rout=z,
+                   cat_bits=jnp.zeros((n, cw), jnp.int32))
 
     def set_leaf(self, i, s: SplitResult, ok=None) -> "_BestSplits":
         def u(arr, v):
@@ -150,7 +162,8 @@ class _BestSplits(NamedTuple):
             rg=u(self.rg, s.right_sum_g), rh=u(self.rh, s.right_sum_h),
             rc=u(self.rc, s.right_count),
             lout=u(self.lout, s.left_output),
-            rout=u(self.rout, s.right_output))
+            rout=u(self.rout, s.right_output),
+            cat_bits=u(self.cat_bits, s.cat_bits))
 
 
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -187,6 +200,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     n, f = bins.shape
     L = cfg.num_leaves
     B = cfg.max_bin
+    cw = cat_words(B)
     p = cfg.split
     axis = cfg.axis_name
     mode = cfg.parallel_mode or ("data" if axis is not None else None)
@@ -241,7 +255,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         start = jnp.clip(begin, 0, max(n - cap, 0))
         return start, begin - start
 
-    def partition_segment(perm, begin, rows, feat, thr, dleft, f_is_cat, ok):
+    def partition_segment(perm, begin, rows, feat, thr, dleft, f_is_cat,
+                          cbits, ok):
         """Stable-partition the parent leaf's segment of ``perm`` by the
         split decision.  Returns (perm', nleft) — O(bucket cap) work."""
         def mk(cap):
@@ -257,7 +272,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     colv = jnp.take(jnp.take(bins, seg, axis=0), feat, axis=1)
                 colv = colv.astype(jnp.int32)
                 is_miss = (colv == nan_bins[feat]) & (nan_bins[feat] >= 0)
-                gl = jnp.where(f_is_cat, colv == thr,
+                gl = jnp.where(f_is_cat, bitset_contains(cbits, colv),
                                jnp.where(is_miss, dleft, colv <= thr))
                 ar = jnp.arange(cap, dtype=jnp.int32)
                 valid = (ar >= off) & (ar < off + rows)
@@ -440,6 +455,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             threshold=jnp.zeros(L - 1, jnp.int32),
             default_left=jnp.zeros(L - 1, bool),
             is_cat_split=jnp.zeros(L - 1, bool),
+            cat_bits=jnp.zeros((L - 1, cw), jnp.int32),
             split_gain=jnp.zeros(L - 1, jnp.float32),
             left_child=jnp.full(L - 1, -1, jnp.int32),
             right_child=jnp.full(L - 1, -1, jnp.int32),
@@ -473,7 +489,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       rand=rand_thresholds(0))
 
     hist_store = jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist)
-    best = _BestSplits.empty(L).set_leaf(0, root_split)
+    best = _BestSplits.empty(L, cw).set_leaf(0, root_split)
     # depth gate for root handled trivially (max_depth >= 1 always allows root)
 
     state = dict(
@@ -492,6 +508,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         node_threshold=jnp.zeros(L - 1, jnp.int32),
         node_default_left=jnp.zeros(L - 1, bool),
         node_is_cat=jnp.zeros(L - 1, bool),
+        node_cat_bits=jnp.zeros((L - 1, cw), jnp.int32),
         node_gain=jnp.zeros(L - 1, jnp.float32),
         node_parent=jnp.full(L - 1, -1, jnp.int32),  # parent internal node
         node_is_left=jnp.zeros(L - 1, bool),
@@ -546,7 +563,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             default_left=~f_cat,
             left_sum_g=left[0], left_sum_h=left[1], left_count=left[2],
             right_sum_g=right[0], right_sum_h=right[1], right_count=right[2],
-            left_output=lout, right_output=rout)
+            left_output=lout, right_output=rout,
+            cat_bits=jnp.where(
+                f_cat, pack_bin_bitset(jnp.arange(B, dtype=jnp.int32) == thr),
+                jnp.zeros(cw, jnp.int32)))
 
     def apply_split(j, st, leaf, gain, ok):
         """Apply the pending best split of ``leaf`` as node ``j``.
@@ -571,6 +591,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         feat = b.feature[leaf]
         thr = b.threshold[leaf]
         dleft = b.default_left[leaf]
+        cbits = b.cat_bits[leaf]
         f_is_cat = is_categorical[feat]
         new_id = st["num_leaves"]
 
@@ -580,6 +601,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         st_nt = setw(st["node_threshold"], j, thr)
         st_nd = setw(st["node_default_left"], j, dleft)
         st_nc = setw(st["node_is_cat"], j, f_is_cat)
+        st_ncb = setw(st["node_cat_bits"], j, cbits)
         st_ng = setw(st["node_gain"], j, gain)
         st_np = setw(st["node_parent"], j, parent_node)
         st_nl = setw(st["node_is_left"], j, st["leaf_is_left"][leaf])
@@ -596,7 +618,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             pbegin = st["leaf_begin"][leaf]
             prows = st["leaf_nrows"][leaf]
             perm, nleft = partition_segment(
-                st["perm"], pbegin, prows, feat, thr, dleft, f_is_cat, ok)
+                st["perm"], pbegin, prows, feat, thr, dleft, f_is_cat,
+                cbits, ok)
             extra_part = dict(
                 perm=perm,
                 leaf_begin=setw(st["leaf_begin"], new_id, pbegin + nleft),
@@ -619,7 +642,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
             is_miss = (col == nan_bins[feat]) & (nan_bins[feat] >= 0)
             goes_left = jnp.where(
-                f_is_cat, col == thr,
+                f_is_cat, bitset_contains(cbits, col),
                 jnp.where(is_miss, dleft, col <= thr))
             if mode == "feature":
                 goes_left = jax.lax.psum(
@@ -738,7 +761,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             leaf_sum_g=leaf_sum_g, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
             leaf_parent=leaf_parent, leaf_is_left=leaf_is_left,
             node_feature=st_nf, node_threshold=st_nt,
-            node_default_left=st_nd, node_is_cat=st_nc, node_gain=st_ng,
+            node_default_left=st_nd, node_is_cat=st_nc, node_cat_bits=st_ncb,
+            node_gain=st_ng,
             node_parent=st_np, node_is_left=st_nl, node_value=st_nv,
             node_count=st_ncount,
             num_leaves=st["num_leaves"] + (
@@ -839,6 +863,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         threshold=state["node_threshold"],
         default_left=state["node_default_left"],
         is_cat_split=state["node_is_cat"],
+        cat_bits=state["node_cat_bits"],
         split_gain=state["node_gain"],
         left_child=left_child,
         right_child=right_child,

@@ -31,7 +31,11 @@ def predict_leaf_binned(tree: TreeArrays, bins: jax.Array, nan_bins: jax.Array
         dleft = tree.default_left[node]
         nb = nan_bins[feat]
         is_miss = (col == nb) & (nb >= 0)
-        goes_left = jnp.where(is_cat, col == thr,
+        # categorical: bin-bitset membership (one-hot and sorted subsets)
+        bits = tree.cat_bits[node]                           # [N, CW]
+        word = jnp.take_along_axis(bits, (col >> 5)[:, None], axis=1)[:, 0]
+        cat_left = ((word >> (col & 31)) & 1) == 1
+        goes_left = jnp.where(is_cat, cat_left,
                               jnp.where(is_miss, dleft, col <= thr))
         nxt = jnp.where(goes_left, tree.left_child[node], tree.right_child[node])
         return jnp.where(cur >= 0, nxt, cur)

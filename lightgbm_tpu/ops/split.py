@@ -42,6 +42,8 @@ class SplitParams(NamedTuple):
     cat_smooth: float
     cat_l2: float
     max_cat_to_onehot: int
+    max_cat_threshold: int = 32
+    min_data_per_group: int = 100
 
 
 class SplitResult(NamedTuple):
@@ -59,6 +61,11 @@ class SplitResult(NamedTuple):
     right_count: jax.Array
     left_output: jax.Array
     right_output: jax.Array
+    # categorical membership bitset over BIN ids ([ceil(B/32)] int32): for a
+    # categorical split, bins with a set bit go LEFT (one-hot = single bit;
+    # sorted many-category subsets = the elected prefix).  Zeros for numeric
+    # splits.  The analog of SplitInfo::cat_threshold.
+    cat_bits: jax.Array
 
 
 def threshold_l1(s, l1):
@@ -133,9 +140,16 @@ def _split_gain_matrix(hist, num_bins, nan_bins, is_categorical, monotone,
     num_gain = jnp.where(use_left, gain_l, gain_r)                     # [F, B]
 
     # --- categorical one-hot: left = (bin == k) -------------------------------
+    # only for low-cardinality features (reference use_onehot dispatch,
+    # feature_histogram.hpp:316); larger cardinalities use the sorted scan
+    # bin 0 is the unseen/other/NaN catch-all (io/bin.py categorical layout):
+    # it cannot be expressed in a category-VALUE bitset, so it is never a
+    # left-set member — those rows always go right, like unseen categories
+    # at predict time
     cat_left = hist                                                     # [F, B, 3]
     cat_right = total[None, None, :] - cat_left
-    cat_valid = (bin_ids < num_bins[:, None])
+    cat_valid = (bin_ids >= 1) & (bin_ids < num_bins[:, None]) & \
+        (num_bins[:, None] <= p.max_cat_to_onehot)
     cat_gain, cat_out = _gain_at(cat_left, cat_right, total, monotone, p,
                                  parent_output, output_lo, output_hi, cat_valid,
                                  extra_l2=p.cat_l2)
@@ -157,6 +171,123 @@ def _split_gain_matrix(hist, num_bins, nan_bins, is_categorical, monotone,
     return gain_fb, use_left, cum, miss
 
 
+def cat_words(b: int) -> int:
+    """Bitset words needed for ``b`` bins."""
+    return max(1, -(-b // 32))
+
+
+def pack_bin_bitset(member: jax.Array) -> jax.Array:
+    """Pack a ``[..., B]`` membership mask into ``[..., ceil(B/32)]`` i32."""
+    b = member.shape[-1]
+    cw = cat_words(b)
+    pad = cw * 32 - b
+    if pad:
+        member = jnp.pad(member, [(0, 0)] * (member.ndim - 1) + [(0, pad)])
+    m = member.reshape(member.shape[:-1] + (cw, 32)).astype(jnp.uint32)
+    packed = jnp.sum(m << jnp.arange(32, dtype=jnp.uint32), axis=-1,
+                     dtype=jnp.uint32)
+    return jax.lax.bitcast_convert_type(packed, jnp.int32)
+
+
+def bitset_contains(bits: jax.Array, idx: jax.Array) -> jax.Array:
+    """Test bit ``idx`` of a ``[CW]`` i32 bitset (vectorized over ``idx``)."""
+    word = jnp.take(bits, idx >> 5, mode="clip")
+    return ((word >> (idx & 31)) & 1) == 1
+
+
+def _sorted_cat_best(hist, num_bins, is_categorical, monotone, total,
+                     p: SplitParams, feature_mask, parent_output,
+                     output_lo, output_hi, gain_penalty=None):
+    """Sorted many-category split scan, vectorized over features.
+
+    Reference ``FindBestThresholdCategoricalInner`` sorted branch
+    (``feature_histogram.hpp:378-474``): bins with enough data are sorted by
+    ``sum_grad/(sum_hess + cat_smooth)`` and prefixes from BOTH ends (up to
+    ``min(max_cat_threshold, (used+1)/2)`` categories) are candidate left
+    sets, with ``min_data_per_group`` gating candidate prefixes.  One
+    deviation: the reference estimates bin counts from hessians
+    (``cnt_factor``); the count channel here is exact.
+
+    Returns ``(gain [F], bits [F, CW] i32, left_sums [F, 3])`` with
+    ``NEG_INF`` gain for features where the sorted scan does not apply.
+    """
+    f, b, _ = hist.shape
+    cw = cat_words(b)
+    if f == 0:
+        z = jnp.zeros((0,), jnp.float32)
+        return z, jnp.zeros((0, cw), jnp.int32), jnp.zeros((0, 3), jnp.float32)
+    maxT = max(1, min(p.max_cat_threshold, b))
+    g, h, c = hist[..., 0], hist[..., 1], hist[..., 2]
+    bin_ids = jnp.arange(b, dtype=jnp.int32)[None, :]
+    active = (is_categorical & (num_bins > p.max_cat_to_onehot)
+              & (feature_mask > 0))                                 # [F]
+    # bin 0 (unseen/other/NaN catch-all) is excluded from left-set
+    # membership — see the one-hot branch in _split_gain_matrix
+    elig = ((c >= p.cat_smooth) & (bin_ids >= 1)
+            & (bin_ids < num_bins[:, None]))                        # [F, B]
+    used_bin = jnp.sum(elig, axis=1)                                # [F]
+    max_num_cat = jnp.minimum(p.max_cat_threshold, (used_bin + 1) // 2)
+    score = jnp.where(elig, g / (h + p.cat_smooth), jnp.inf)
+    p_eff = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
+    pen = gain_penalty if gain_penalty is not None else jnp.zeros(f, jnp.float32)
+    mono = monotone
+
+    def scan_dir(order_score):
+        idx = jnp.argsort(order_score, axis=1, stable=True)         # [F, B]
+        tk = lambda a: jnp.take_along_axis(jnp.where(elig, a, 0.0), idx, axis=1)
+        cum_g = jnp.cumsum(tk(g), axis=1)[:, :maxT]
+        cum_h = jnp.cumsum(tk(h), axis=1)[:, :maxT] + 1e-15         # kEpsilon
+        cum_c = jnp.cumsum(tk(c), axis=1)[:, :maxT]
+        sc_step = tk(c)[:, :maxT]
+
+        def body(i, carry):
+            cnt_grp, best_gain, best_i = carry
+            lg, lh, lc = cum_g[:, i], cum_h[:, i], cum_c[:, i]
+            rg, rh, rc = total[0] - lg, total[1] - lh, total[2] - lc
+            cnt_grp = cnt_grp + sc_step[:, i]
+            in_range = i < jnp.minimum(used_bin, max_num_cat)
+            gate1 = (lc >= p.min_data_in_leaf) & (lh >= p.min_sum_hessian_in_leaf)
+            nobrk = ((rc >= p.min_data_in_leaf) & (rc >= p.min_data_per_group)
+                     & (rh >= p.min_sum_hessian_in_leaf))
+            grp_ok = cnt_grp >= p.min_data_per_group
+            considered = active & in_range & gate1 & nobrk & grp_ok
+            cnt_grp = jnp.where(in_range & gate1 & nobrk & grp_ok,
+                                0.0, cnt_grp)
+            lo_out = leaf_output(lg, lh, p_eff, parent_output, lc,
+                                 output_lo, output_hi)
+            ro_out = leaf_output(rg, rh, p_eff, parent_output, rc,
+                                 output_lo, output_hi)
+            bad = ((mono > 0) & (lo_out > ro_out)) | ((mono < 0) & (lo_out < ro_out))
+            gain = (leaf_gain(lg, lh, p_eff, parent_output, lc,
+                              output_lo, output_hi)
+                    + leaf_gain(rg, rh, p_eff, parent_output, rc,
+                                output_lo, output_hi)) - pen
+            gain = jnp.where(considered & ~bad, gain, NEG_INF)
+            better = gain > best_gain
+            return (cnt_grp,
+                    jnp.where(better, gain, best_gain),
+                    jnp.where(better, i, best_i))
+
+        init = (jnp.zeros(f, jnp.float32), jnp.full(f, NEG_INF, jnp.float32),
+                jnp.zeros(f, jnp.int32))
+        _, best_gain, best_i = jax.lax.fori_loop(0, maxT, body, init)
+        return best_gain, best_i, idx
+
+    g_asc, i_asc, idx_asc = scan_dir(score)
+    g_dsc, i_dsc, idx_dsc = scan_dir(jnp.where(elig, -score, jnp.inf))
+    use_dsc = g_dsc > g_asc
+    best_gain = jnp.where(use_dsc, g_dsc, g_asc)
+    best_i = jnp.where(use_dsc, i_dsc, i_asc)
+    idx = jnp.where(use_dsc[:, None], idx_dsc, idx_asc)
+
+    memb_sorted = jnp.arange(b, dtype=jnp.int32)[None, :] <= best_i[:, None]
+    memb_bins = jnp.zeros((f, b), bool).at[
+        jnp.arange(f, dtype=jnp.int32)[:, None], idx].set(memb_sorted)
+    bits = pack_bin_bitset(memb_bins)                               # [F, CW]
+    left = jnp.sum(jnp.where(memb_bins[:, :, None], hist, 0.0), axis=1)
+    return best_gain, bits, left
+
+
 def per_feature_gains(hist, num_bins, nan_bins, is_categorical, monotone,
                       sum_g, sum_h, count, p: SplitParams, feature_mask,
                       parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF
@@ -168,7 +299,10 @@ def per_feature_gains(hist, num_bins, nan_bins, is_categorical, monotone,
     gain_fb, _, _, _ = _split_gain_matrix(
         hist, num_bins, nan_bins, is_categorical, monotone, total, p,
         feature_mask, parent_output, output_lo, output_hi)
-    return jnp.max(gain_fb, axis=1)
+    gain_sorted, _, _ = _sorted_cat_best(
+        hist, num_bins, is_categorical, monotone, total, p, feature_mask,
+        parent_output, output_lo, output_hi)
+    return jnp.maximum(jnp.max(gain_fb, axis=1), gain_sorted)
 
 
 def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Array,
@@ -188,33 +322,59 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
       output_lo/output_hi: monotone bounds for this leaf's subtree.
     """
     f, b, _ = hist.shape
+    cw = cat_words(b)
     total = jnp.stack([sum_g, sum_h, count]).astype(jnp.float32)       # [3]
     gain_fb, use_left, cum, miss = _split_gain_matrix(
         hist, num_bins, nan_bins, is_categorical, monotone, total, p,
         feature_mask, parent_output, output_lo, output_hi, gain_penalty,
         rand_threshold)
+    gain_sorted, bits_sorted, left_sorted = _sorted_cat_best(
+        hist, num_bins, is_categorical, monotone, total, p, feature_mask,
+        parent_output, output_lo, output_hi, gain_penalty)
 
     # --- argmax over (feature, threshold) ------------------------------------
     flat = gain_fb.reshape(-1)
     best_idx = jnp.argmax(flat)
-    best_gain = flat[best_idx]
-    best_f = (best_idx // b).astype(jnp.int32)
-    best_t = (best_idx % b).astype(jnp.int32)
+    grid_gain = flat[best_idx]
+    # sorted-subset candidates compete per feature
+    sorted_f = jnp.argmax(gain_sorted).astype(jnp.int32) if f else jnp.int32(0)
+    use_sorted = (gain_sorted[sorted_f] > grid_gain) if f else jnp.asarray(False)
+    best_gain = jnp.where(use_sorted, gain_sorted[sorted_f], grid_gain)
+    best_f = jnp.where(use_sorted, sorted_f, (best_idx // b).astype(jnp.int32))
+    best_t = jnp.where(use_sorted, 0, (best_idx % b).astype(jnp.int32))
     bf_cat = is_categorical[best_f]
-    bf_missing_left = jnp.where(bf_cat, False, use_left[best_f, best_t])
+    bf_missing_left = jnp.where(bf_cat, False,
+                                use_left[best_f, jnp.where(use_sorted, 0, best_t)])
+
+    # categorical membership bitset: sorted prefix, or the one-hot bin's bit
+    onehot_bits = pack_bin_bitset(
+        jnp.arange(b, dtype=jnp.int32) == best_t)                      # [CW]
+    cat_bits = jnp.where(use_sorted, bits_sorted[sorted_f],
+                         jnp.where(bf_cat, onehot_bits,
+                                   jnp.zeros(cw, jnp.int32)))
 
     # recompute chosen split's child sums
     def pick(arr):
         return arr[best_f, best_t]
     left_num = pick(cum) + jnp.where(bf_missing_left, miss[best_f], 0.0)
     left_cat = pick(hist)
-    left = jnp.where(bf_cat, left_cat, left_num)
+    left = jnp.where(use_sorted, left_sorted[sorted_f],
+                     jnp.where(bf_cat, left_cat, left_num))
     right = total - left
 
-    lo_out = leaf_output(left[0], left[1], p, parent_output, left[2],
-                         output_lo, output_hi)
-    hi_out = leaf_output(right[0], right[1], p, parent_output, right[2],
-                         output_lo, output_hi)
+    # categorical outputs use the categorical L2 (reference computes
+    # CalculateSplittedLeafOutput with l2 += cat_l2 for cat splits)
+    p_cat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
+
+    def out_of(s):
+        return jnp.where(
+            bf_cat,
+            leaf_output(s[0], s[1], p_cat, parent_output, s[2],
+                        output_lo, output_hi),
+            leaf_output(s[0], s[1], p, parent_output, s[2],
+                        output_lo, output_hi))
+    lo_out = out_of(left)
+    hi_out = out_of(right)
 
     # parent gain baseline: reported gain is improvement over parent
     parent_gain = leaf_gain(total[0], total[1], p, parent_output, total[2],
@@ -229,6 +389,7 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
         left_sum_g=left[0], left_sum_h=left[1], left_count=left[2],
         right_sum_g=right[0], right_sum_h=right[1], right_count=right[2],
         left_output=lo_out, right_output=hi_out,
+        cat_bits=cat_bits,
     )
 
 
