@@ -119,10 +119,13 @@ def unrolled_rank(sorted_vals: jax.Array, targets: jax.Array,
 
 
 _PALLAS_BLOCK_ROWS = 1024
-# lane budget per feature block: FC features of Bp padded bins each ride the
-# MXU as one [6, BR] @ [BR, FC*Bp] dot; ~2k lanes keeps the VMEM-resident
-# one-hot tile (BR*FC*Bp bf16) around 4MB
+# lane budget per feature block: FC features of Bp padded bins ride the MXU
+# as one [6, BR] x [FC*Bp, BR]^T dot.  FC has an 8-sublane floor (the bins
+# block is (FC, BR)), so for wide bins (Bp > 256) the lane budget alone
+# cannot bound the one-hot tile — _hist_pallas also shrinks BR to keep
+# FC*Bp*BR bf16 within _PALLAS_ONEHOT_BYTES of VMEM.
 _PALLAS_BLOCK_LANES = 2048
+_PALLAS_ONEHOT_BYTES = 4 * 1024 * 1024
 
 
 def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None):
@@ -140,8 +143,13 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None):
     - **feature-blocked grid**: grid is (feature_blocks, row_blocks), rows
       minor, so each [6, FC*Bp] output block stays VMEM-resident while all row
       blocks accumulate into it (TPU grid is sequential -> race-free), and the
-      one-hot only ever exists as a [BR, FC*Bp] VMEM tile.  Any F works — no
+      one-hot only ever exists as a [FC*Bp, BR] VMEM tile.  Any F works — no
       flat-bins cap, no per-feature Python unroll.
+    - **feature-major bins layout**: bins ride the kernel transposed as
+      ``[f_pad, Npad]`` so the block shape is ``(FC, BR)`` — FC a multiple of
+      8 sublanes and BR a multiple of 128 lanes, as Mosaic's block-shape rule
+      requires (a row-major ``(BR, FC)`` block has FC on lanes and cannot
+      lower for multi-block feature grids).
 
     This replaces the reference's CPU hot loop (``dense_bin.hpp:97-142``) and
     its per-workgroup local-memory GPU kernels
@@ -152,10 +160,13 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None):
     n, f = bins.shape
     B = max_bin
     Bp = -(-B // 128) * 128                      # lane-tile aligned bin width
-    FC = max(1, _PALLAS_BLOCK_LANES // Bp)       # features per block
+    FC = max(8, _PALLAS_BLOCK_LANES // Bp)       # features per block (8-mult)
     n_fb = -(-f // FC)
     f_pad = n_fb * FC
-    BR = min(block_rows or _PALLAS_BLOCK_ROWS, max(16, n))
+    # bound the VMEM-resident one-hot tile: FC*Bp*BR bf16 <= budget
+    br_cap = max(128, (_PALLAS_ONEHOT_BYTES // (2 * FC * Bp)) // 128 * 128)
+    BR = max(128, min(block_rows or _PALLAS_BLOCK_ROWS, br_cap,
+                      -(-n // 128) * 128))
 
     gh = jnp.stack([grad * mask, hess * mask, mask], axis=0).astype(jnp.float32)
     hi = gh.astype(jnp.bfloat16)
@@ -164,11 +175,9 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None):
 
     pad = (-n) % BR
     if pad:
-        bins = jnp.pad(bins, ((0, pad), (0, 0)))
         gh6 = jnp.pad(gh6, ((0, 0), (0, pad)))
         # padded rows carry zero weight in every channel
-    if f_pad > f:
-        bins = jnp.pad(bins, ((0, 0), (0, f_pad - f)))
+    bins_t = jnp.pad(bins.T, ((0, f_pad - f), (0, pad)))          # [f_pad, Npad]
     n_rb = (n + pad) // BR
 
     def kernel(bins_ref, gh_ref, out_ref):
@@ -176,23 +185,23 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None):
         def _init():
             out_ref[:] = jnp.zeros_like(out_ref)
 
-        b = bins_ref[:].astype(jnp.int32)                     # [BR, FC]
-        bin_id = jax.lax.broadcasted_iota(jnp.int32, (BR, FC, Bp), 2)
-        onehot = (b[:, :, None] == bin_id).astype(jnp.bfloat16)
-        onehot = onehot.reshape(BR, FC * Bp)
+        b = bins_ref[:].astype(jnp.int32)                     # [FC, BR]
+        bin_id = jax.lax.broadcasted_iota(jnp.int32, (FC, Bp, BR), 1)
+        onehot = (b[:, None, :] == bin_id).astype(jnp.bfloat16)
+        onehot = onehot.reshape(FC * Bp, BR)
         out_ref[:] += jax.lax.dot_general(
             gh_ref[:], onehot,
-            dimension_numbers=(((1,), (0,)), ((), ())),
+            dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [6, FC*Bp]
 
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((6, f_pad * Bp), jnp.float32),
         grid=(n_fb, n_rb),
-        in_specs=[pl.BlockSpec((BR, FC), lambda fb, i: (i, fb)),
+        in_specs=[pl.BlockSpec((FC, BR), lambda fb, i: (fb, i)),
                   pl.BlockSpec((6, BR), lambda fb, i: (0, i))],
         out_specs=pl.BlockSpec((6, FC * Bp), lambda fb, i: (0, fb)),
-    )(bins, gh6)
+    )(bins_t, gh6)
     out = out.reshape(2, 3, f_pad, Bp)
     hist = out[0] + out[1]                                    # hi + lo parts
     return hist[:, :f, :B].transpose(1, 2, 0)
