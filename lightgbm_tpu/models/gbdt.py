@@ -39,7 +39,12 @@ class GBDT:
         self.config = config
         self.train_data: Optional[Dataset] = None
         self.objective = objective
-        self.models: List[Tree] = []
+        self._models: List[Tree] = []
+        # deferred host trees: (tree_arrays, shrinkage, bias, iter) tuples
+        # whose device->host copies are in flight (see `models` property)
+        self._pending: List[tuple] = []
+        self._stop_flag = False
+        self._empty_by_iter: Dict[int, int] = {}
         self.valid_sets: List[Dataset] = []
         self.valid_names: List[str] = []
         self.iter_ = 0
@@ -59,6 +64,47 @@ class GBDT:
         self._tree_weights: List[float] = []  # current scale of each model
         if train_data is not None:
             self.init_train(train_data)
+
+    # ------------------------------------------------------------------
+    # Deferred host-tree materialization.  Over a remote-tunnel backend every
+    # synchronous device fetch stalls the host for a round-trip, so the fast
+    # training path (no leaf renewal / linear trees / CEGB) keeps the whole
+    # iteration on device, starts an async device->host copy of the tree
+    # arrays, and only builds the host-side ``Tree`` when someone actually
+    # reads ``self.models`` — by which time the copy has long landed.
+    @property
+    def models(self) -> List[Tree]:
+        self._drain_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value: List[Tree]) -> None:
+        self._pending.clear()
+        self._models = value
+
+    def _drain_pending(self, keep: int = 0) -> None:
+        """Materialize pending device trees (oldest first), leaving at most
+        ``keep`` in flight."""
+        while len(self._pending) > keep:
+            arrs, shrink, bias, _it = self._pending.pop(0)
+            host = jax.device_get(arrs)
+            nl = int(host.num_leaves)
+            tree = Tree.from_arrays(host, self.train_data, learning_rate=1.0)
+            tree.shrink(shrink)
+            if bias:
+                if nl > 1:
+                    tree.add_bias(bias)
+                else:
+                    tree.leaf_value = np.full_like(tree.leaf_value, bias)
+            self._models.append(tree)
+            if nl <= 1:
+                # when ALL trees of an iteration are split-less, report stop
+                # on the next update (one iteration late vs the reference's
+                # synchronous check, gbdt.cpp:375-388)
+                cnt = self._empty_by_iter.get(_it, 0) + 1
+                self._empty_by_iter[_it] = cnt
+                if cnt >= self.num_tree_per_iteration:
+                    self._stop_flag = True
 
     # ------------------------------------------------------------------
     def init_train(self, train_data: Dataset) -> None:
@@ -142,6 +188,14 @@ class GBDT:
             max_cat_to_onehot=cfg.max_cat_to_onehot,
             max_cat_threshold=cfg.max_cat_threshold,
             min_data_per_group=cfg.min_data_per_group)
+        # static: does any feature take the sorted many-category scan?
+        # (num_bin > max_cat_to_onehot categorical, feature_histogram.hpp:316)
+        ds = self.train_data
+        from ..io.bin import BinType
+        sorted_cat = any(
+            ds.bin_mappers[r].bin_type == BinType.CATEGORICAL
+            and ds.num_bin(i) > cfg.max_cat_to_onehot
+            for i, r in enumerate(ds.used_features))
         return GrowerConfig(
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth, max_bin=max_bin,
             split=sp, feature_fraction_bynode=cfg.feature_fraction_bynode,
@@ -152,7 +206,8 @@ class GBDT:
             hist_compact=cfg.hist_compact,
             hist_compact_min_cap=cfg.hist_compact_min_cap,
             hist_compact_ladder=cfg.hist_compact_ladder,
-            extra_trees=cfg.extra_trees)
+            extra_trees=cfg.extra_trees,
+            sorted_cat=sorted_cat)
 
     # ------------------------------------------------------------------
     # feature-gating state: interaction constraints + CEGB (SURVEY.md §2.4)
@@ -382,6 +437,11 @@ class GBDT:
         n = self.train_data.num_data
         it = self.iter_
 
+        if self._stop_flag:
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            return True
+
         with global_timer.scope("GBDT::gradients"):
             if grad is None or hess is None:
                 g, h = self._compute_gradients(self._train_score)
@@ -393,6 +453,15 @@ class GBDT:
         row_weight = bag_mask if bag_mask is not None else jnp.ones(n, jnp.float32)
         fmask = self._feature_mask(it)
         self._prev_scores = (self._train_score, list(self._valid_scores))
+
+        cegb_coupled0, cegb_used0 = self._cegb_state()
+        _, cegb_lazy0 = self._cegb_vectors()
+        fast = ((self.objective is None
+                 or not self.objective.need_renew_tree_output())
+                and not cfg.linear_tree
+                and cegb_coupled0 is None and cegb_lazy0 is None)
+        if fast:
+            return self._train_one_iter_fast(g, h, row_weight, fmask, it, K)
 
         should_stop = True
         for k in range(K):
@@ -477,6 +546,44 @@ class GBDT:
             Log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
         return should_stop
+
+    def _train_one_iter_fast(self, g, h, row_weight, fmask, it: int,
+                             K: int) -> bool:
+        """Device-resident iteration: grow, score-update and valid-update all
+        stay on device; the host tree materializes lazily (``models``
+        property), so the boosting loop issues work without ever blocking on
+        the device — the per-tree host round-trip of the synchronous path
+        disappears from the critical path."""
+        cfg = self.config
+        for k in range(K):
+            with global_timer.scope("GBDT::grow_tree"):
+                tree_arrays, node_assign = self._grow_jit(
+                    self._dd.bins, g[k], h[k], row_weight, fmask,
+                    key_for_iteration(cfg.seed, it, salt=k + 1), None, None)
+            jax.tree.map(lambda a: a.copy_to_host_async(), tree_arrays)
+            bias = (self.init_scores[k]
+                    if it == 0 and self.init_scores[k] != 0.0 else 0.0)
+            self._pending.append((tree_arrays, self.shrinkage_rate, bias, it))
+            with global_timer.scope("GBDT::update_score"):
+                gate = tree_arrays.num_leaves > 1
+                delta = tree_arrays.leaf_value * self.shrinkage_rate
+                self._train_score = self._train_score.at[k].add(
+                    jnp.where(gate, delta[node_assign], 0.0))
+                for vi, vset in enumerate(self.valid_sets):
+                    vleaf = self._predict_leaf_jit(tree_arrays,
+                                                   vset.device_data().bins)
+                    self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
+                        jnp.where(gate, delta[vleaf], 0.0))
+            self._device_trees.append(tree_arrays)
+            self._tree_weights.append(self.shrinkage_rate)
+        self.iter_ += 1
+        # keep one iteration in flight: draining then blocks only on the
+        # PREVIOUS iteration's device work (host stays a full iteration
+        # ahead) and its async device->host copy has typically landed, so
+        # the device_get is a cache read, not a round-trip.  The stop check
+        # is therefore one iteration late (at most K extra constant trees).
+        self._drain_pending(keep=K)
+        return self._stop_flag
 
     def _compute_gradients(self, score):
         obj = self.objective
@@ -892,6 +999,10 @@ class GBDT:
         self._tree_weights = self._tree_weights[:-K]
         self._ens_cache = None
         self.iter_ -= 1
+        # the rolled-back iteration's empty-tree accounting must not leak
+        # into a retrain of the same iteration (or pin _stop_flag)
+        self._empty_by_iter.pop(self.iter_, None)
+        self._stop_flag = False
         self._train_score, self._valid_scores = self._prev_scores
         self._prev_scores = None
 

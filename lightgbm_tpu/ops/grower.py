@@ -105,6 +105,10 @@ class GrowerConfig(NamedTuple):
     # extremely-randomized trees: one random threshold per feature per node
     # (reference USE_RAND, feature_histogram.hpp:115-217)
     extra_trees: bool = False
+    # static: dataset has a many-category feature (num_bins > max_cat_to_onehot)
+    # — when False the sorted-categorical scan is skipped at trace time,
+    # removing ~128 sequential tiny ops + 4 argsorts from every split step
+    sorted_cat: bool = True
 
 
 class TreeArrays(NamedTuple):
@@ -255,22 +259,50 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         start = jnp.clip(begin, 0, max(n - cap, 0))
         return start, begin - start
 
-    def partition_segment(perm, begin, rows, feat, thr, dleft, f_is_cat,
-                          cbits, ok):
-        """Stable-partition the parent leaf's segment of ``perm`` by the
-        split decision.  Returns (perm', nleft) — O(bucket cap) work."""
+    # Per-tree combined row payload for the fused partition+histogram pass:
+    # the 12 bytes of (grad, hess, row_weight) ride INSIDE the bins rows as
+    # extra bin-typed columns, so ONE row gather moves everything.  On v5e a
+    # u8 [N, F] row is lane-padded to a 128-byte tile row for any F<=128, so
+    # the extra byte-columns are free at gather time, while a separate f32
+    # [N, 3] gather benched ~2x the bins gather (XLA lays [N, small] out
+    # column-major, scattering each row's fields 4MB apart).
+    _gh_cols = 12 // bins.dtype.itemsize          # 12 bytes as bin-typed cols
+    _gh_packed = jax.lax.bitcast_convert_type(
+        jnp.stack([grad, hess, row_weight], axis=1), bins.dtype
+    ).reshape(n, _gh_cols)
+    comb = jnp.concatenate([bins, _gh_packed], axis=1)    # [N, F + gh_cols]
+
+    def _unpack_gh(combb):
+        """[cap, 3] f32 (grad, hess, row_weight) back out of a gathered
+        combined block."""
+        cap = combb.shape[0]
+        raw = combb[:, f:].reshape(cap, 3, _gh_cols // 3)
+        return jax.lax.bitcast_convert_type(raw, jnp.float32)
+
+    def partition_and_hist(perm, begin, rows, feat, thr, dleft, f_is_cat,
+                           cbits, ok, left_smaller):
+        """One switch over the parent-cap ladder: gather the parent segment's
+        rows ONCE, decide the split, stable-partition the perm segment, and
+        histogram the smaller child from the gathered block with a side mask.
+
+        Fuses the reference's ``DataPartition::Split`` + smaller-child
+        ``ConstructHistograms`` (serial_tree_learner.cpp:324-372,564-682).
+        The fusion is the point: a per-split flat ``bins.reshape(-1)`` column
+        gather benched at a fixed ~0.7 ms relayout of the whole bins array,
+        and the separate child histogram paid a second row gather — here the
+        parent block is gathered once and both consumers read it from VMEM-
+        friendly layout.  Returns (perm', nleft, small_hist)."""
         def mk(cap):
             def br(perm):
                 start, off = _seg_window(begin, cap)
                 seg = jax.lax.dynamic_slice(perm, (start,), (cap,))
-                if n * f < 2 ** 31:
-                    # flat [row*F + feat] gather of the split column
-                    colv = jnp.take(bins.reshape(-1), seg * f + feat)
-                else:
-                    # n*f would overflow the int32 flat index: gather the
-                    # rows, then the (dynamic) column
-                    colv = jnp.take(jnp.take(bins, seg, axis=0), feat, axis=1)
-                colv = colv.astype(jnp.int32)
+                combb = jnp.take(comb, seg, axis=0)       # [cap, F+gh_cols]
+                ghb = _unpack_gh(combb)                   # [cap, 3]
+                # split column via one-hot reduce — a dynamic minor-axis
+                # take would relayout the whole block
+                fsel = (jnp.arange(combb.shape[1], dtype=jnp.int32) == feat)
+                colv = jnp.sum(combb.astype(jnp.int32) * fsel[None, :],
+                               axis=1)
                 is_miss = (colv == nan_bins[feat]) & (nan_bins[feat] >= 0)
                 gl = jnp.where(f_is_cat, bitset_contains(cbits, colv),
                                jnp.where(is_miss, dleft, colv <= thr))
@@ -289,32 +321,24 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 if ok is not None:
                     new_seg = jnp.where(ok, new_seg, seg)
                     nleft = jnp.where(ok, nleft, 0)
-                return jax.lax.dynamic_update_slice(perm, new_seg, (start,)), nleft
+                new_perm = jax.lax.dynamic_update_slice(perm, new_seg,
+                                                        (start,))
+                m = jnp.where(valid & (gl == left_smaller), ghb[:, 2], 0.0)
+                # histogram the WHOLE combined block: the gh byte-columns
+                # histogram garbage that is sliced off below — cheaper than
+                # a minor-axis slice relayout of the block
+                h = build_histogram(combb, ghb[:, 0], ghb[:, 1], m, B,
+                                    method=cfg.hist_method,
+                                    chunk_rows=cfg.hist_chunk_rows)
+                return new_perm, nleft, h[:f]
             return br
         idx = jnp.searchsorted(jnp.asarray(caps, jnp.int32), rows)
-        return jax.lax.switch(idx, [mk(c) for c in caps], perm)
-
-    def hist_of_segment(perm, begin, rows):
-        """Histogram over the contiguous leaf segment [begin, begin+rows) of
-        the partition — the hot call replacing full-mask histograms."""
-        def mk(cap):
-            def br(perm):
-                start, off = _seg_window(begin, cap)
-                seg = jax.lax.dynamic_slice(perm, (start,), (cap,))
-                ar = jnp.arange(cap, dtype=jnp.int32)
-                valid = (ar >= off) & (ar < off + rows)
-                m = jnp.where(valid, jnp.take(row_weight, seg), 0.0)
-                return build_histogram(jnp.take(bins, seg, axis=0),
-                                       jnp.take(grad, seg),
-                                       jnp.take(hess, seg), m, B,
-                                       method=cfg.hist_method,
-                                       chunk_rows=cfg.hist_chunk_rows)
-            return br
-        idx = jnp.searchsorted(jnp.asarray(caps, jnp.int32), rows)
-        h = jax.lax.switch(idx, [mk(c) for c in caps], perm)
+        new_perm, nleft, h = jax.lax.switch(idx, [mk(c) for c in caps], perm)
         if mode == "data":
+            # collective stays OUTSIDE the data-dependent switch: shards may
+            # pick different buckets, all join here
             h = jax.lax.psum(h, axis)
-        return h
+        return new_perm, nleft, h
 
     def hist_of(mask, nrows=None):
         def full(m):
@@ -372,7 +396,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                      if penalty is not None else None)
             s = find_best_split(hist, num_bins_l, default_bins_l, nan_bins_l,
                                 is_cat_l, mono_l, sum_g, sum_h, count, p,
-                                fmask_l, parent_output, lo, hi, pen_l, rand)
+                                fmask_l, parent_output, lo, hi, pen_l, rand,
+                                sorted_cat=cfg.sorted_cat)
             # local winner carries a shard-local feature id; globalize and
             # allreduce-max the packed SplitInfo (parallel_tree_learner.h:191)
             s = s._replace(feature=s.feature + f_start)
@@ -382,7 +407,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                 parent_output, lo, hi, penalty, rand)
         return find_best_split(hist, num_bins_l, default_bins_l, nan_bins_l,
                                is_cat_l, mono_l, sum_g, sum_h, count, p,
-                               fmask, parent_output, lo, hi, penalty, rand)
+                               fmask, parent_output, lo, hi, penalty, rand,
+                               sorted_cat=cfg.sorted_cat)
 
     def _find_voting(hist, sum_g, sum_h, count, fmask, parent_output, lo, hi,
                      penalty=None, rand=None):
@@ -396,7 +422,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             min_sum_hessian_in_leaf=p.min_sum_hessian_in_leaf / ns)
         fg = per_feature_gains(hist, num_bins_l, nan_bins_l, is_cat_l, mono_l,
                                sum_g / ns, sum_h / ns, count / ns, p_loc,
-                               fmask, parent_output, lo, hi)
+                               fmask, parent_output, lo, hi,
+                               sorted_cat=cfg.sorted_cat)
         k = min(cfg.top_k, f_full)
         topv, topi = jax.lax.top_k(fg, k)
         votes = jnp.zeros(f_full, jnp.float32).at[topi].add(
@@ -412,7 +439,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         emask = jnp.where(fmask > 0, emask, 0.0)
         return find_best_split(hist_e, num_bins_l, default_bins_l, nan_bins_l,
                                is_cat_l, mono_l, sum_g, sum_h, count, p,
-                               emask, parent_output, lo, hi, penalty, rand)
+                               emask, parent_output, lo, hi, penalty, rand,
+                               sorted_cat=cfg.sorted_cat)
 
     use_cegb = (cegb_coupled is not None or cegb_lazy is not None
                 or cfg.cegb_split_penalty > 0.0)
@@ -614,20 +642,18 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         left_smaller = b.lc[leaf] <= b.rc[leaf]
         if use_partition:
             # reorder only the parent leaf's segment of the row permutation
-            # (DataPartition::Split, data_partition.hpp): O(parent rows)
+            # (DataPartition::Split, data_partition.hpp) and histogram the
+            # smaller child from the same gathered block: O(parent rows)
             pbegin = st["leaf_begin"][leaf]
             prows = st["leaf_nrows"][leaf]
-            perm, nleft = partition_segment(
+            perm, nleft, small_hist = partition_and_hist(
                 st["perm"], pbegin, prows, feat, thr, dleft, f_is_cat,
-                cbits, ok)
+                cbits, ok, left_smaller)
             extra_part = dict(
                 perm=perm,
                 leaf_begin=setw(st["leaf_begin"], new_id, pbegin + nleft),
                 leaf_nrows=setw(setw(st["leaf_nrows"], leaf, nleft),
                                 new_id, prows - nleft))
-            sbegin = jnp.where(left_smaller, pbegin, pbegin + nleft)
-            srows = jnp.where(left_smaller, nleft, prows - nleft)
-            small_hist = hist_of_segment(perm, sbegin, srows)
             in_leaf = goes_left = None
         else:
             if mode == "feature":
@@ -732,24 +758,38 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         rand = rand_thresholds(j + 1)
 
-        def child_best(hist_c, g, h, c, lo_, hi_, mask_c):
-            pen = None
-            if use_cegb:
-                pen = cegb_penalty(mask_c, c, feat_used, used_data)
-            s = find(hist_c, g, h, c, fmask, 0.0, lo_, hi_, penalty=pen,
-                     rand=rand)
-            return s._replace(gain=jnp.where(depth_ok, s.gain, NEG_INF))
-
         if use_partition:
             # CEGB-lazy (the only penalty needing row masks) is mask-path-only
             lmask = rmask = None
         else:
             lmask = jnp.where(in_leaf & goes_left, rw_pos, 0.0)
             rmask = jnp.where(in_leaf & ~goes_left, rw_pos, 0.0)
-        sl = child_best(lhist, b.lg[leaf], b.lh[leaf], b.lc[leaf],
-                        l_lo, l_hi, lmask)
-        sr = child_best(rhist, b.rg[leaf], b.rh[leaf], b.rc[leaf],
-                        r_lo, r_hi, rmask)
+
+        # both children's split searches ride ONE vmapped call: the search is
+        # dominated by fixed small-op overhead at [F, B] scale, so batching
+        # the pair halves the per-split serial op count
+        hist2 = jnp.stack([lhist, rhist])
+        g2 = jnp.stack([b.lg[leaf], b.rg[leaf]])
+        h2 = jnp.stack([b.lh[leaf], b.rh[leaf]])
+        c2 = jnp.stack([b.lc[leaf], b.rc[leaf]])
+        lo2 = jnp.stack([l_lo, r_lo])
+        hi2 = jnp.stack([l_hi, r_hi])
+        if use_cegb:
+            pen2 = jnp.stack([cegb_penalty(lmask, c2[0], feat_used, used_data),
+                              cegb_penalty(rmask, c2[1], feat_used, used_data)])
+            s2 = jax.vmap(
+                lambda hc, g_, h_, c_, lo_, hi_, pen_: find(
+                    hc, g_, h_, c_, fmask, 0.0, lo_, hi_, penalty=pen_,
+                    rand=rand)
+            )(hist2, g2, h2, c2, lo2, hi2, pen2)
+        else:
+            s2 = jax.vmap(
+                lambda hc, g_, h_, c_, lo_, hi_: find(
+                    hc, g_, h_, c_, fmask, 0.0, lo_, hi_, rand=rand)
+            )(hist2, g2, h2, c2, lo2, hi2)
+        s2 = s2._replace(gain=jnp.where(depth_ok, s2.gain, NEG_INF))
+        sl = jax.tree.map(lambda a: a[0], s2)
+        sr = jax.tree.map(lambda a: a[1], s2)
         best = cur_best.set_leaf(leaf, sl, ok).set_leaf(new_id, sr, ok)
 
         return dict(

@@ -58,7 +58,10 @@ def _build_histogram_xla(bins, grad, hess, mask, max_bin, *,
 def _hist_scatter(bins, grad, hess, mask, max_bin):
     n, f = bins.shape
     gh = jnp.stack([grad * mask, hess * mask, mask], axis=-1)        # [N, 3]
-    flat = bins.astype(jnp.int32) + max_bin * jnp.arange(f, dtype=jnp.int32)[None, :]
+    # clip keeps out-of-range values (e.g. the grower's packed gh byte-columns)
+    # inside their own column's space; the one-hot paths drop them by compare
+    clipped = jnp.minimum(bins.astype(jnp.int32), max_bin - 1)
+    flat = clipped + max_bin * jnp.arange(f, dtype=jnp.int32)[None, :]
     out = jnp.zeros((f * max_bin, 3), dtype=jnp.float32)
     vals = jnp.broadcast_to(gh[:, None, :], (n, f, 3)).reshape(n * f, 3)
     out = out.at[flat.reshape(-1)].add(vals)
@@ -128,6 +131,11 @@ _PALLAS_BLOCK_LANES = 2048
 _PALLAS_ONEHOT_BYTES = 4 * 1024 * 1024
 
 
+# cap so that the 128-row BR floor never busts _PALLAS_ONEHOT_BYTES:
+# f*Bp*128 bf16 <= 4MiB  =>  f*Bp <= 16384
+_PALLAS_ROWMAJOR_MAX_LANES = 16384
+
+
 def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None):
     """Fused histogram: Pallas TPU kernel, bf16 split-precision one-hot matmul.
 
@@ -140,16 +148,23 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None):
       rows (g_hi, h_hi, m_hi, g_lo, h_lo, m_lo) ride the SAME matmul (M <= 8
       sublanes is free) with f32 accumulation, so the whole histogram runs at
       the MXU's bf16 rate — ~4x the f32 rate — with ~1e-5 relative error.
-    - **feature-blocked grid**: grid is (feature_blocks, row_blocks), rows
-      minor, so each [6, FC*Bp] output block stays VMEM-resident while all row
-      blocks accumulate into it (TPU grid is sequential -> race-free), and the
-      one-hot only ever exists as a [FC*Bp, BR] VMEM tile.  Any F works — no
-      flat-bins cap, no per-feature Python unroll.
-    - **feature-major bins layout**: bins ride the kernel transposed as
-      ``[f_pad, Npad]`` so the block shape is ``(FC, BR)`` — FC a multiple of
-      8 sublanes and BR a multiple of 128 lanes, as Mosaic's block-shape rule
-      requires (a row-major ``(BR, FC)`` block has FC on lanes and cannot
-      lower for multi-block feature grids).
+    Two layouts, chosen by total lane width (Mosaic requires a block's last
+    dim to be a 128-multiple or the full array dim):
+
+    - **row-major single feature block** (``f*Bp <= 32k`` lanes): the bins
+      block is ``(BR, f)`` — legal because ``f`` is the full array width —
+      so bins ride straight from the dataset layout with NO transpose.  (A
+      per-call ``[cap, F] -> [F, cap]`` u8 transpose benched at a fixed
+      ~0.7 ms on v5e regardless of cap — pure relayout latency — which
+      dominated small-segment histograms.)  Grid is (row_blocks,); the
+      [6, f*Bp] output block stays VMEM-resident across all row blocks
+      (TPU grid is sequential -> race-free accumulation).
+    - **feature-major blocked** (wide features, e.g. EFB-bundled data): bins
+      are transposed to ``[f_pad, Npad]`` and the block is ``(FC, BR)`` —
+      FC on sublanes (8-aligned), BR on lanes (128-aligned) — with grid
+      (feature_blocks, row_blocks), rows minor, so each [6, FC*Bp] output
+      block accumulates in VMEM while the one-hot only ever exists as a
+      [FC*Bp, BR] tile.
 
     This replaces the reference's CPU hot loop (``dense_bin.hpp:97-142``) and
     its per-workgroup local-memory GPU kernels
@@ -160,48 +175,92 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None):
     n, f = bins.shape
     B = max_bin
     Bp = -(-B // 128) * 128                      # lane-tile aligned bin width
-    FC = max(8, _PALLAS_BLOCK_LANES // Bp)       # features per block (8-mult)
-    n_fb = -(-f // FC)
-    f_pad = n_fb * FC
-    # bound the VMEM-resident one-hot tile: FC*Bp*BR bf16 <= budget
-    br_cap = max(128, (_PALLAS_ONEHOT_BYTES // (2 * FC * Bp)) // 128 * 128)
-    BR = max(128, min(block_rows or _PALLAS_BLOCK_ROWS, br_cap,
-                      -(-n // 128) * 128))
 
     gh = jnp.stack([grad * mask, hess * mask, mask], axis=0).astype(jnp.float32)
     hi = gh.astype(jnp.bfloat16)
     lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
     gh6 = jnp.concatenate([hi, lo], axis=0)                       # [6, N] bf16
 
-    pad = (-n) % BR
-    if pad:
-        gh6 = jnp.pad(gh6, ((0, 0), (0, pad)))
-        # padded rows carry zero weight in every channel
-    bins_t = jnp.pad(bins.T, ((0, f_pad - f), (0, pad)))          # [f_pad, Npad]
-    n_rb = (n + pad) // BR
+    if f * Bp <= _PALLAS_ROWMAJOR_MAX_LANES:
+        # ---- row-major path: one feature block spans all features ----------
+        f_pad = f
+        # BR is the bins block's sublane dim AND the gh block's lane dim, so
+        # it must be a 128-multiple
+        br_cap = max(128, (_PALLAS_ONEHOT_BYTES // (2 * f_pad * Bp)) // 128 * 128)
+        BR = max(128, min(block_rows or _PALLAS_BLOCK_ROWS, br_cap,
+                          -(-n // 128) * 128))
+        pad = (-n) % BR
+        if pad:
+            bins = jnp.pad(bins, ((0, pad), (0, 0)))
+            gh6 = jnp.pad(gh6, ((0, 0), (0, pad)))
+            # padded rows carry zero weight in every channel
+        n_rb = (n + pad) // BR
 
-    def kernel(bins_ref, gh_ref, out_ref):
-        @pl.when(pl.program_id(1) == 0)
-        def _init():
-            out_ref[:] = jnp.zeros_like(out_ref)
+        def kernel_rm(bins_ref, gh_ref, out_ref):
+            @pl.when(pl.program_id(0) == 0)
+            def _init():
+                out_ref[:] = jnp.zeros_like(out_ref)
 
-        b = bins_ref[:].astype(jnp.int32)                     # [FC, BR]
-        bin_id = jax.lax.broadcasted_iota(jnp.int32, (FC, Bp, BR), 1)
-        onehot = (b[:, None, :] == bin_id).astype(jnp.bfloat16)
-        onehot = onehot.reshape(FC * Bp, BR)
-        out_ref[:] += jax.lax.dot_general(
-            gh_ref[:], onehot,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)               # [6, FC*Bp]
+            # transpose the small [BR, f] tile in VMEM so the one-hot can be
+            # built as [f, Bp, BR] and reshaped [f*Bp, BR] by merging LEADING
+            # dims (layout-free).  A [BR, f, Bp] -> [BR, f*Bp] reshape would
+            # merge a non-lane-aligned dim into lanes — a per-step relayout
+            # that benched ~10x slower.
+            b = bins_ref[:].astype(jnp.int32).T               # [f_pad, BR]
+            bin_id = jax.lax.broadcasted_iota(jnp.int32, (f_pad, Bp, BR), 1)
+            onehot = (b[:, None, :] == bin_id).astype(jnp.bfloat16)
+            onehot = onehot.reshape(f_pad * Bp, BR)
+            out_ref[:] += jax.lax.dot_general(
+                gh_ref[:], onehot,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)           # [6, f_pad*Bp]
 
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((6, f_pad * Bp), jnp.float32),
-        grid=(n_fb, n_rb),
-        in_specs=[pl.BlockSpec((FC, BR), lambda fb, i: (fb, i)),
-                  pl.BlockSpec((6, BR), lambda fb, i: (0, i))],
-        out_specs=pl.BlockSpec((6, FC * Bp), lambda fb, i: (0, fb)),
-    )(bins_t, gh6)
+        out = pl.pallas_call(
+            kernel_rm,
+            out_shape=jax.ShapeDtypeStruct((6, f_pad * Bp), jnp.float32),
+            grid=(n_rb,),
+            in_specs=[pl.BlockSpec((BR, f_pad), lambda i: (i, 0)),
+                      pl.BlockSpec((6, BR), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((6, f_pad * Bp), lambda i: (0, 0)),
+        )(bins, gh6)
+    else:
+        # ---- feature-major blocked path (wide features) --------------------
+        FC = max(8, _PALLAS_BLOCK_LANES // Bp)   # features per block (8-mult)
+        n_fb = -(-f // FC)
+        f_pad = n_fb * FC
+        # bound the VMEM-resident one-hot tile: FC*Bp*BR bf16 <= budget
+        br_cap = max(128, (_PALLAS_ONEHOT_BYTES // (2 * FC * Bp)) // 128 * 128)
+        BR = max(128, min(block_rows or _PALLAS_BLOCK_ROWS, br_cap,
+                          -(-n // 128) * 128))
+        pad = (-n) % BR
+        if pad:
+            gh6 = jnp.pad(gh6, ((0, 0), (0, pad)))
+        bins_t = jnp.pad(bins.T, ((0, f_pad - f), (0, pad)))  # [f_pad, Npad]
+        n_rb = (n + pad) // BR
+
+        def kernel_fm(bins_ref, gh_ref, out_ref):
+            @pl.when(pl.program_id(1) == 0)
+            def _init():
+                out_ref[:] = jnp.zeros_like(out_ref)
+
+            b = bins_ref[:].astype(jnp.int32)                 # [FC, BR]
+            bin_id = jax.lax.broadcasted_iota(jnp.int32, (FC, Bp, BR), 1)
+            onehot = (b[:, None, :] == bin_id).astype(jnp.bfloat16)
+            onehot = onehot.reshape(FC * Bp, BR)
+            out_ref[:] += jax.lax.dot_general(
+                gh_ref[:], onehot,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)           # [6, FC*Bp]
+
+        out = pl.pallas_call(
+            kernel_fm,
+            out_shape=jax.ShapeDtypeStruct((6, f_pad * Bp), jnp.float32),
+            grid=(n_fb, n_rb),
+            in_specs=[pl.BlockSpec((FC, BR), lambda fb, i: (fb, i)),
+                      pl.BlockSpec((6, BR), lambda fb, i: (0, i))],
+            out_specs=pl.BlockSpec((6, FC * Bp), lambda fb, i: (0, fb)),
+        )(bins_t, gh6)
+
     out = out.reshape(2, 3, f_pad, Bp)
     hist = out[0] + out[1]                                    # hi + lo parts
     return hist[:, :f, :B].transpose(1, 2, 0)

@@ -290,8 +290,8 @@ def _sorted_cat_best(hist, num_bins, is_categorical, monotone, total,
 
 def per_feature_gains(hist, num_bins, nan_bins, is_categorical, monotone,
                       sum_g, sum_h, count, p: SplitParams, feature_mask,
-                      parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF
-                      ) -> jax.Array:
+                      parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF,
+                      sorted_cat: bool = True) -> jax.Array:
     """Best candidate gain per feature — ``[F]``.  Used by the voting-parallel
     learner's local top-k proposal (reference ``VotingParallelTreeLearner``,
     ``voting_parallel_tree_learner.cpp:151``)."""
@@ -299,10 +299,13 @@ def per_feature_gains(hist, num_bins, nan_bins, is_categorical, monotone,
     gain_fb, _, _, _ = _split_gain_matrix(
         hist, num_bins, nan_bins, is_categorical, monotone, total, p,
         feature_mask, parent_output, output_lo, output_hi)
-    gain_sorted, _, _ = _sorted_cat_best(
-        hist, num_bins, is_categorical, monotone, total, p, feature_mask,
-        parent_output, output_lo, output_hi)
-    return jnp.maximum(jnp.max(gain_fb, axis=1), gain_sorted)
+    best = jnp.max(gain_fb, axis=1)
+    if sorted_cat:
+        gain_sorted, _, _ = _sorted_cat_best(
+            hist, num_bins, is_categorical, monotone, total, p, feature_mask,
+            parent_output, output_lo, output_hi)
+        best = jnp.maximum(best, gain_sorted)
+    return best
 
 
 def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Array,
@@ -310,7 +313,8 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
                     monotone: jax.Array, sum_g, sum_h, count,
                     p: SplitParams, feature_mask: jax.Array,
                     parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF,
-                    gain_penalty=None, rand_threshold=None) -> SplitResult:
+                    gain_penalty=None, rand_threshold=None,
+                    sorted_cat: bool = True) -> SplitResult:
     """Find the best split of a leaf given its histogram.
 
     Args:
@@ -328,9 +332,17 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
         hist, num_bins, nan_bins, is_categorical, monotone, total, p,
         feature_mask, parent_output, output_lo, output_hi, gain_penalty,
         rand_threshold)
-    gain_sorted, bits_sorted, left_sorted = _sorted_cat_best(
-        hist, num_bins, is_categorical, monotone, total, p, feature_mask,
-        parent_output, output_lo, output_hi, gain_penalty)
+    if sorted_cat:
+        gain_sorted, bits_sorted, left_sorted = _sorted_cat_best(
+            hist, num_bins, is_categorical, monotone, total, p, feature_mask,
+            parent_output, output_lo, output_hi, gain_penalty)
+    else:
+        # statically no many-category feature in the dataset: the sorted scan
+        # (2 argsorts + 2 maxT-step fori_loops of tiny ops) is pure per-split
+        # overhead — skip it at trace time
+        gain_sorted = jnp.full(max(f, 1), NEG_INF, jnp.float32)
+        bits_sorted = jnp.zeros((max(f, 1), cw), jnp.int32)
+        left_sorted = jnp.zeros((max(f, 1), 3), jnp.float32)
 
     # --- argmax over (feature, threshold) ------------------------------------
     flat = gain_fb.reshape(-1)
@@ -338,7 +350,8 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
     grid_gain = flat[best_idx]
     # sorted-subset candidates compete per feature
     sorted_f = jnp.argmax(gain_sorted).astype(jnp.int32) if f else jnp.int32(0)
-    use_sorted = (gain_sorted[sorted_f] > grid_gain) if f else jnp.asarray(False)
+    use_sorted = ((gain_sorted[sorted_f] > grid_gain) if f and sorted_cat
+                  else jnp.asarray(False))
     best_gain = jnp.where(use_sorted, gain_sorted[sorted_f], grid_gain)
     best_f = jnp.where(use_sorted, sorted_f, (best_idx // b).astype(jnp.int32))
     best_t = jnp.where(use_sorted, 0, (best_idx % b).astype(jnp.int32))

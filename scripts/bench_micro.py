@@ -1,0 +1,83 @@
+"""Microbench the grower's per-split primitives on the live backend.
+
+Isolates: row gather (both layouts), u8 transpose, partition scatter,
+cumsum, and the pallas histogram at ladder cap sizes.
+
+usage: PYTHONPATH=/root/.axon_site:/root/repo python scripts/bench_micro.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N, F, B = 1_000_000, 28, 256
+rng = np.random.default_rng(0)
+bins = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
+bins_t = jnp.asarray(np.asarray(bins).T.copy())
+g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+h = jnp.asarray(np.full(N, 0.25, np.float32))
+
+
+def timed(name, fn, *args, iters=20):
+    r = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:44s} {dt*1e3:8.3f} ms")
+    return dt
+
+
+for cap in (16384, 131072, 1_000_000):
+    seg = jnp.asarray(rng.integers(0, N, size=cap, dtype=np.int32))
+
+    timed(f"gather rows [cap={cap},F] axis0",
+          jax.jit(lambda s: jnp.take(bins, s, axis=0)), seg)
+    timed(f"gather cols [F,cap={cap}] axis1 (bins_t)",
+          jax.jit(lambda s: jnp.take(bins_t, s, axis=1)), seg)
+    timed(f"gather rows+transpose [F,cap={cap}]",
+          jax.jit(lambda s: jnp.take(bins, s, axis=0).T.copy()), seg)
+    timed(f"gather gh [cap={cap}]",
+          jax.jit(lambda s: (jnp.take(g, s), jnp.take(h, s))), seg)
+    timed(f"cumsum i32 [cap={cap}]",
+          jax.jit(lambda s: jnp.cumsum(s)), seg)
+    pos = jnp.asarray(rng.permutation(cap).astype(np.int32))
+    timed(f"scatter set [cap={cap}]",
+          jax.jit(lambda p_, s: jnp.zeros(cap, jnp.int32).at[p_].set(s)),
+          pos, seg)
+
+    from lightgbm_tpu.ops.histogram import _hist_pallas
+    bc = jnp.take(bins, seg, axis=0)
+    gc, hc = jnp.take(g, seg), jnp.take(h, seg)
+    mc = jnp.ones(cap, jnp.float32)
+    timed(f"pallas hist [cap={cap}]",
+          jax.jit(lambda b_, g_, h_, m_: _hist_pallas(b_, g_, h_, m_, B)),
+          bc, gc, hc, mc)
+    print()
+
+# --- combined-payload and physical-partition primitives ------------------
+print("=== combined payload / physical partition ===")
+gh_bytes = jax.lax.bitcast_convert_type(
+    jnp.stack([g, h, jnp.ones(N, jnp.float32)], axis=1), jnp.uint8
+).reshape(N, 12)
+comb = jnp.concatenate([bins, gh_bytes], axis=1)        # [N, 40] u8
+comb = jax.block_until_ready(comb)
+for cap in (16384, 131072, 524288):
+    seg = jnp.asarray(rng.integers(0, N, size=cap, dtype=np.int32))
+    timed(f"gather comb rows [cap={cap},40]",
+          jax.jit(lambda s: jnp.take(comb, s, axis=0)), seg)
+    pos = jnp.asarray(rng.permutation(cap).astype(np.int32))
+    block = jnp.take(comb, seg, axis=0)
+    timed(f"scatter comb rows [cap={cap},40]",
+          jax.jit(lambda p_, b_: jnp.zeros((cap, 40), jnp.uint8).at[p_].set(b_)),
+          pos, block)
+    timed(f"gather-by-invperm comb rows [cap={cap},40]",
+          jax.jit(lambda p_, b_: jnp.take(b_, p_, axis=0)), pos, block)
+    timed(f"contiguous read+sum comb [cap={cap},40]",
+          jax.jit(lambda b_: b_.astype(jnp.float32).sum()), block)
+    # monotonic (sorted) index gather — compaction-style access
+    mono = jnp.sort(seg)
+    timed(f"gather comb rows SORTED idx [cap={cap}]",
+          jax.jit(lambda s: jnp.take(comb, s, axis=0)), mono)

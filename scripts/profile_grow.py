@@ -33,9 +33,11 @@ sp = SplitParams(lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=100,
                  min_sum_hessian_in_leaf=100.0, min_gain_to_split=0.0,
                  max_delta_step=0.0, path_smooth=0.0, cat_smooth=10.0,
                  cat_l2=10.0, max_cat_to_onehot=4)
+import os
 cfg = GrowerConfig(num_leaves=leaves, max_depth=-1, max_bin=B, split=sp,
                    feature_fraction_bynode=1.0, hist_method="pallas",
-                   hist_chunk_rows=chunk, hist_compact=compact)
+                   hist_chunk_rows=chunk, hist_compact=compact,
+                   sorted_cat=bool(int(os.environ.get("PROF_SORTED_CAT", "0"))))
 
 
 @jax.jit
