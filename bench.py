@@ -65,6 +65,21 @@ def main() -> None:
     booster._gbdt._train_score.block_until_ready()
     elapsed = time.perf_counter() - t0
 
+    # accuracy guardrail: in-sample AUC of the trained ensemble (the
+    # reference's north star is throughput at IDENTICAL AUC — a kernel
+    # change that silently trades accuracy must show up here); reuses the
+    # package's tie-correct AUC metric
+    import numpy as _np
+    from lightgbm_tpu.metric.base import AUCMetric
+    from lightgbm_tpu.io.dataset import Metadata
+    from lightgbm_tpu.config import Config as _Cfg
+    score = _np.asarray(booster._gbdt._train_score[0], _np.float64)
+    md = Metadata(n_rows)
+    md.set_field("label", y)
+    auc_metric = AUCMetric(_Cfg())
+    auc_metric.init(md, n_rows)
+    (_, auc, _), = auc_metric.eval(score)
+
     sec_per_tree = elapsed / n_iters
     row_iters_per_sec = n_rows * n_iters / elapsed
     print(json.dumps({
@@ -76,6 +91,7 @@ def main() -> None:
             "rows": n_rows, "iters_timed": n_iters,
             "num_leaves": num_leaves,
             "sec_per_tree": round(sec_per_tree, 4),
+            "auc": round(auc, 6),
             "backend": __import__("jax").default_backend(),
         },
     }))

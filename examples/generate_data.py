@@ -1,0 +1,75 @@
+"""Generate the example datasets (synthetic stand-ins with the reference's
+file formats: TSV with the label in column 0; `.query` files for ranking;
+`.weight` files for weighted training).
+
+Run from the repo root or the examples dir:
+    python examples/generate_data.py
+"""
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _write_tsv(path, y, X):
+    with open(path, "w") as f:
+        for yi, row in zip(y, X):
+            f.write("\t".join([f"{yi:g}"] + [f"{v:.6g}" for v in row]) + "\n")
+
+
+def binary(n_train=7000, n_test=500, n_feat=28, seed=7):
+    rng = np.random.default_rng(seed)
+    n = n_train + n_test
+    X = rng.normal(size=(n, n_feat))
+    logit = 1.3 * X[:, 0] - 0.9 * X[:, 1] + X[:, 2] * X[:, 3] + 0.4 * X[:, 4] ** 2
+    y = (logit + rng.logistic(size=n) > 0).astype(np.int64)
+    d = os.path.join(HERE, "binary_classification")
+    _write_tsv(os.path.join(d, "binary.train"), y[:n_train], X[:n_train])
+    _write_tsv(os.path.join(d, "binary.test"), y[n_train:], X[n_train:])
+    w = rng.uniform(0.5, 1.5, size=n)
+    np.savetxt(os.path.join(d, "binary.train.weight"), w[:n_train], fmt="%.4f")
+    np.savetxt(os.path.join(d, "binary.test.weight"), w[n_train:], fmt="%.4f")
+
+
+def regression(n_train=7000, n_test=500, n_feat=20, seed=11):
+    rng = np.random.default_rng(seed)
+    n = n_train + n_test
+    X = rng.normal(size=(n, n_feat))
+    y = (2.0 * X[:, 0] + X[:, 1] ** 2 - 1.5 * X[:, 2] * X[:, 3]
+         + rng.normal(scale=0.3, size=n))
+    d = os.path.join(HERE, "regression")
+    _write_tsv(os.path.join(d, "regression.train"), y[:n_train], X[:n_train])
+    _write_tsv(os.path.join(d, "regression.test"), y[n_train:], X[n_train:])
+
+
+def lambdarank(n_queries=200, seed=13, n_feat=16):
+    rng = np.random.default_rng(seed)
+    d = os.path.join(HERE, "lambdarank")
+
+    def make(nq, fname, qname):
+        rows, labels, qsizes = [], [], []
+        for _ in range(nq):
+            sz = int(rng.integers(5, 25))
+            qsizes.append(sz)
+            X = rng.normal(size=(sz, n_feat))
+            rel = X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.7, size=sz)
+            lab = np.clip(np.digitize(rel, [-0.5, 0.5, 1.5]), 0, 4)
+            rows.append(X)
+            labels.append(lab)
+        _write_tsv(fname, np.concatenate(labels), np.concatenate(rows))
+        np.savetxt(qname, np.asarray(qsizes, np.int64), fmt="%d")
+
+    make(n_queries, os.path.join(d, "rank.train"),
+         os.path.join(d, "rank.train.query"))
+    make(max(20, n_queries // 5), os.path.join(d, "rank.test"),
+         os.path.join(d, "rank.test.query"))
+
+
+if __name__ == "__main__":
+    for sub in ("binary_classification", "regression", "lambdarank"):
+        os.makedirs(os.path.join(HERE, sub), exist_ok=True)
+    binary()
+    regression()
+    lambdarank()
+    print("example datasets written under", HERE)

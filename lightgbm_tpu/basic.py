@@ -51,11 +51,26 @@ class Dataset:
         data = self.data
         if isinstance(data, str):
             from .io.loader import load_file
-            data, label, feat_names = load_file(data, cfg)
+            import os as _os
+            path = data
+            data, label, feat_names = load_file(path, cfg)
             if self.label is None:
                 self.label = label
             if self.feature_name == "auto" and feat_names:
                 self.feature_name = feat_names
+            # sidecar metadata files, auto-detected like the reference
+            # (Metadata::Init file loaders, src/io/metadata.cpp:
+            # <data>.weight one weight per row, <data>.query group sizes,
+            # <data>.init init scores)
+            if self.weight is None and _os.path.exists(path + ".weight"):
+                self.weight = np.loadtxt(path + ".weight", dtype=np.float64,
+                                         ndmin=1)
+            if self.group is None and _os.path.exists(path + ".query"):
+                self.group = np.loadtxt(path + ".query",
+                                        dtype=np.int64).reshape(-1)
+            if self.init_score is None and _os.path.exists(path + ".init"):
+                self.init_score = np.loadtxt(path + ".init", dtype=np.float64,
+                                             ndmin=1)
         feature_names = None if self.feature_name == "auto" else list(self.feature_name)
         cats = None
         if self.categorical_feature != "auto":

@@ -66,10 +66,16 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     cbs_before.sort(key=lambda cb: getattr(cb, "order", 0))
     cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    snapshot_freq = booster._gbdt.config.snapshot_freq
     for i in range(num_boost_round):
         for cb in cbs_before:
             cb(callback_mod.CallbackEnv(booster, params, i, 0, num_boost_round, None))
         stopped = booster.update(fobj=fobj)
+        if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+            # periodic checkpoint (reference GBDT::Train, gbdt.cpp:277-281):
+            # <output_model>.snapshot_iter_<N>
+            booster.save_model(
+                f"{booster._gbdt.config.output_model}.snapshot_iter_{i + 1}")
 
         evaluation_result_list = []
         if booster._gbdt.valid_sets or booster._gbdt.config.is_provide_training_metric:

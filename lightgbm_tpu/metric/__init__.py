@@ -9,7 +9,8 @@ from .base import (Metric, L1Metric, L2Metric, RMSEMetric, QuantileMetric,
                    HuberMetric, FairMetric, PoissonMetric, MAPEMetric,
                    GammaMetric, GammaDevianceMetric, TweedieMetric,
                    BinaryLoglossMetric, BinaryErrorMetric, AUCMetric,
-                   AveragePrecisionMetric, MultiLoglossMetric, MultiErrorMetric)
+                   AveragePrecisionMetric, MultiLoglossMetric, MultiErrorMetric,
+                   AucMuMetric)
 
 _ALIASES = {
     "mean_squared_error": "l2", "mse": "l2", "regression": "l2", "regression_l2": "l2",
@@ -32,6 +33,7 @@ _REGISTRY = {
     "binary_error": BinaryErrorMetric, "auc": AUCMetric,
     "average_precision": AveragePrecisionMetric,
     "multi_logloss": MultiLoglossMetric, "multi_error": MultiErrorMetric,
+    "auc_mu": AucMuMetric,
 }
 
 

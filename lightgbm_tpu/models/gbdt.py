@@ -794,16 +794,49 @@ class GBDT:
         models = self.models[start_iteration * K:(start_iteration + n_iters) * K]
 
         mode = getattr(self.config, "pred_device", "auto")
-        use_device = models and mode != "host" and (
+        early_stop = (self.config.pred_early_stop
+                      and self.objective is not None
+                      and getattr(self.objective, "name", "") in
+                      ("binary", "multiclass", "multiclassova"))
+        use_device = models and not early_stop and mode != "host" and (
             mode == "device"
             or X.shape[0] * len(models) >= self._DEVICE_PREDICT_MIN_WORK)
         if use_device:
             out = self._predict_raw_device(models, start_iteration, X)
+        elif early_stop:
+            out = self._predict_raw_early_stop(models, X, K)
         else:
             out = np.zeros((X.shape[0], K))
             for ti, t in enumerate(models):
                 out[:, ti % K] += t.predict(X)
         return out[:, 0] if K == 1 else out
+
+    def _predict_raw_early_stop(self, models, X: np.ndarray, K: int):
+        """Margin-based per-row prediction early termination (reference
+        ``prediction_early_stop.cpp``): every ``pred_early_stop_freq`` trees,
+        rows whose margin — ``2*|score|`` for binary, top1−top2 for
+        multiclass — exceeds ``pred_early_stop_margin`` stop accumulating
+        further trees."""
+        cfg = self.config
+        # round the check period up to an iteration boundary: freezing a row
+        # mid-iteration would leave unequal per-class tree counts
+        freq = max(1, cfg.pred_early_stop_freq) * K
+        thresh = cfg.pred_early_stop_margin
+        n = X.shape[0]
+        out = np.zeros((n, K))
+        active = np.ones(n, bool)
+        for ti, t in enumerate(models):
+            out[active, ti % K] += t.predict(X[active])
+            if (ti + 1) % freq == 0 and ti + 1 < len(models):
+                if K == 1:
+                    margin = 2.0 * np.abs(out[:, 0])
+                else:
+                    part = np.partition(out, K - 2, axis=1)
+                    margin = part[:, K - 1] - part[:, K - 2]
+                active &= margin <= thresh
+                if not active.any():
+                    break
+        return out
 
     def _predict_raw_device(self, models, start_iteration: int,
                             X: np.ndarray) -> np.ndarray:

@@ -1,0 +1,92 @@
+"""CLI-vs-Python consistency over the checked-in examples/ configs — the
+analog of the reference's ``tests/python_package_test/test_consistency.py:
+9-50``: run each example's ``train.conf`` through the CLI, train the same
+model through ``lgb.train`` with the parsed params, and assert identical
+predictions; then run ``predict.conf`` and compare its file output to
+Python predictions."""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.application import main as cli_main, parse_config_file
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+@pytest.fixture(scope="module")
+def example_dirs(tmp_path_factory):
+    """Generate the synthetic datasets into a throwaway copy of examples/."""
+    dst = tmp_path_factory.mktemp("examples")
+    for sub in ("binary_classification", "regression", "lambdarank"):
+        shutil.copytree(os.path.join(EXAMPLES, sub), dst / sub)
+    gen = dst / "generate_data.py"
+    shutil.copy(os.path.join(EXAMPLES, "generate_data.py"), gen)
+    subprocess.run([sys.executable, str(gen)], check=True,
+                   env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    return dst
+
+
+def _load_example(d, data_name):
+    raw = np.loadtxt(os.path.join(d, data_name), delimiter="\t")
+    return raw[:, 1:], raw[:, 0]
+
+
+def _run_example(example_dirs, sub, extra_params=None):
+    d = str(example_dirs / sub)
+    cwd = os.getcwd()
+    os.chdir(d)
+    try:
+        assert cli_main(["config=train.conf"]) == 0
+        assert cli_main(["config=predict.conf"]) == 0
+        params = dict(parse_config_file("train.conf"))
+        conf = dict(params)
+        for k in ("task", "data", "valid_data", "output_model",
+                  "is_training_metric", "metric_freq"):
+            conf.pop(k, None)
+        num_trees = int(conf.pop("num_trees"))
+        conf["verbose"] = -1
+        if extra_params:
+            conf.update(extra_params)
+
+        X, y = _load_example(d, params["data"])
+        kwargs = {}
+        wfile = os.path.join(d, params["data"] + ".weight")
+        if os.path.exists(wfile):
+            kwargs["weight"] = np.loadtxt(wfile)
+        qfile = os.path.join(d, params["data"] + ".query")
+        if os.path.exists(qfile):
+            kwargs["group"] = np.loadtxt(qfile).astype(int)
+        train = lgb.Dataset(X, label=y, params=conf, **kwargs)
+        bst = lgb.train(conf, train, num_trees)
+
+        cli_model = lgb.Booster(model_file=os.path.join(d, params["output_model"]))
+        Xte, _ = _load_example(d, parse_config_file("predict.conf")["data"])
+        p_cli_model = cli_model.predict(Xte)
+        p_py = bst.predict(Xte)
+        # CLI and Python ran the same pipeline: identical predictions
+        np.testing.assert_allclose(p_cli_model, p_py, rtol=1e-9, atol=1e-12)
+        # and the CLI's own prediction output file matches too
+        p_file = np.loadtxt(os.path.join(d, "LightGBM_predict_result.txt"))
+        np.testing.assert_allclose(
+            p_file, p_cli_model if p_cli_model.ndim == 1 else p_cli_model,
+            rtol=1e-6)
+    finally:
+        os.chdir(cwd)
+
+
+def test_binary_example(example_dirs):
+    _run_example(example_dirs, "binary_classification")
+
+
+def test_regression_example(example_dirs):
+    _run_example(example_dirs, "regression")
+
+
+def test_lambdarank_example(example_dirs):
+    _run_example(example_dirs, "lambdarank")
