@@ -393,14 +393,15 @@ class Config:
         self.monotone_constraints_method = self.monotone_constraints_method.lower()
         check(self.monotone_constraints_method in ("basic", "intermediate", "advanced"),
               f"unknown monotone_constraints_method: {self.monotone_constraints_method}")
-        if self.monotone_constraints_method != "basic" and self.monotone_constraints:
-            # basic-mode bounds are the strictest of the three reference modes
-            # (monotone_constraints.hpp), so falling back preserves the
-            # monotonicity guarantee, only losing some split quality
-            Log.warning("monotone_constraints_method=%s is not implemented yet; "
-                        "falling back to 'basic' (constraints still enforced)",
-                        self.monotone_constraints_method)
-            self.monotone_constraints_method = "basic"
+        if self.monotone_constraints_method == "advanced" and self.monotone_constraints:
+            # intermediate bounds are a superset of advanced's guarantees
+            # (monotone_constraints.hpp AdvancedLeafConstraints adds
+            # per-threshold cumulative slack on top), so falling back
+            # preserves monotonicity, only losing some split quality
+            Log.warning("monotone_constraints_method=advanced is not "
+                        "implemented yet; falling back to 'intermediate' "
+                        "(constraints still enforced)")
+            self.monotone_constraints_method = "intermediate"
         check(self.boosting in BOOSTING_TYPES, f"unknown boosting type: {self.boosting}")
         check(self.tree_learner in TREE_LEARNER_TYPES, f"unknown tree learner: {self.tree_learner}")
         check(self.device_type in DEVICE_TYPES, f"unknown device type: {self.device_type}")

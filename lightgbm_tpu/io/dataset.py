@@ -75,7 +75,8 @@ class Metadata:
 @dataclass
 class DeviceData:
     """Device-resident tensors consumed by the tree learner."""
-    bins: Any            # [num_data, num_features] uint8/uint16 (jnp)
+    bins: Any            # [num_data, num_cols] uint8/uint16 (jnp) — EFB
+    #                      bundle columns when efb is set, else per-feature
     num_bins: Any        # [num_features] int32 — bins per feature
     bin_offsets: Any     # [num_features+1] int32 — flattened histogram offsets
     default_bins: Any    # [num_features] int32 — bin containing raw value 0
@@ -83,6 +84,10 @@ class DeviceData:
     is_categorical: Any  # [num_features] bool
     monotone: Any        # [num_features] int8 (-1/0/+1)
     total_bins: int
+    # EFB (io/efb.py): static (feat_bundle, feat_off, num_bins) numpy arrays
+    # + max bundle width, or (None, 0) when bins are per-feature columns
+    efb: Any = None
+    bundle_bins: int = 0
 
 
 class Dataset:
@@ -104,6 +109,11 @@ class Dataset:
         # raw feature values, kept only for linear trees (the reference keeps
         # Dataset::raw_data_ when linear_tree=true, dataset.h:717)
         self.raw_data: Optional[np.ndarray] = None
+        # EFB state (io/efb.py): None when bundling is off / had no effect
+        self.bundles: Optional[List[List[int]]] = None
+        self.feat_bundle: Optional[np.ndarray] = None   # [num_features] i32
+        self.feat_off: Optional[np.ndarray] = None      # [num_features] i32
+        self.bundle_widths: Optional[np.ndarray] = None  # [n_bundles] i32
 
     # ------------------------------------------------------------------
     @property
@@ -143,6 +153,10 @@ class Dataset:
             self._construct_bin_mappers(data, cats)
 
         self._bin_data(data)
+        if reference is not None:
+            self._adopt_bundling(reference)
+        else:
+            self._apply_bundling()
         if config.linear_tree or (reference is not None
                                   and reference.raw_data is not None):
             self.raw_data = np.asarray(data, np.float32)
@@ -200,6 +214,68 @@ class Dataset:
         self.bins = bins
 
     # ------------------------------------------------------------------
+    # EFB (io/efb.py; reference FindGroups, src/io/dataset.cpp:60-180)
+    def _apply_bundling(self) -> None:
+        cfg = self.config
+        if (not cfg.enable_bundle or self.num_features <= 1
+                or cfg.tree_learner in ("feature", "voting")):
+            return
+        from .efb import (MAX_BUNDLE_BINS, build_bundle_matrix, bundle_layout,
+                          find_bundles)
+        feats = self.used_features
+        nb = np.array([self.bin_mappers[f].num_bin for f in feats], np.int64)
+        can = np.array([
+            self.bin_mappers[f].bin_type == BinType.NUMERICAL
+            and self.bin_mappers[f].default_bin == 0
+            and self.bin_mappers[f].num_bin <= MAX_BUNDLE_BINS
+            for f in feats])
+        if int(can.sum()) < 2:
+            return
+        n = self.num_data
+        s = min(n, max(1, cfg.bin_construct_sample_cnt))
+        sample_idx = Random(cfg.data_random_seed + 1).sample(n, s)
+        bundles = find_bundles(self.bins[sample_idx], nb, can)
+        if len(bundles) >= self.num_features:
+            return                                     # nothing bundled
+        feat_bundle, feat_off, widths = bundle_layout(bundles, nb)
+        Log.info("EFB: bundled %d features into %d dense columns",
+                 self.num_features, len(bundles))
+        self.bins = build_bundle_matrix(self.bins, bundles, feat_off, widths)
+        self.bundles = bundles
+        self.feat_bundle = feat_bundle
+        self.feat_off = feat_off
+        self.bundle_widths = widths
+
+    def _adopt_bundling(self, reference: "Dataset") -> None:
+        """Validation sets pack with the training set's bundle layout."""
+        if reference.bundles is None:
+            return
+        from .efb import build_bundle_matrix
+        self.bins = build_bundle_matrix(
+            self.bins, reference.bundles, reference.feat_off,
+            reference.bundle_widths)
+        self.bundles = reference.bundles
+        self.feat_bundle = reference.feat_bundle
+        self.feat_off = reference.feat_off
+        self.bundle_widths = reference.bundle_widths
+
+    def unbundled_bins(self) -> np.ndarray:
+        """Per-feature ``[N, F]`` bin matrix, decoding bundles if present
+        (host-side paths: continued-training warm-up)."""
+        if self.bundles is None:
+            return self.bins
+        from .efb import decode_bundle_column
+        nb = np.array([self.bin_mappers[f].num_bin
+                       for f in self.used_features], np.int64)
+        dtype = np.uint8 if int(nb.max(initial=2)) <= 256 else np.uint16
+        out = np.zeros((self.num_data, self.num_features), dtype=dtype)
+        for i in range(self.num_features):
+            col = self.bins[:, self.feat_bundle[i]].astype(np.int64)
+            out[:, i] = decode_bundle_column(
+                col, int(self.feat_off[i]), int(nb[i])).astype(dtype)
+        return out
+
+    # ------------------------------------------------------------------
     def device_data(self, monotone_constraints: Optional[Sequence[int]] = None) -> DeviceData:
         """Materialize device tensors (lazily cached)."""
         if self._device is not None and monotone_constraints is None:
@@ -222,6 +298,12 @@ class Dataset:
             for i, f in enumerate(feats):
                 if f < len(mc):
                     mono[i] = mc[f]
+        efb = None
+        bundle_bins = 0
+        if self.bundles is not None:
+            efb = (self.feat_bundle.astype(np.int32),
+                   self.feat_off.astype(np.int32), nb.astype(np.int32))
+            bundle_bins = int(self.bundle_widths.max())
         dd = DeviceData(
             bins=jnp.asarray(self.bins),
             num_bins=jnp.asarray(nb),
@@ -231,6 +313,8 @@ class Dataset:
             is_categorical=jnp.asarray(is_cat),
             monotone=jnp.asarray(mono),
             total_bins=int(offsets[-1]),
+            efb=efb,
+            bundle_bins=bundle_bins,
         )
         if monotone_constraints is None:
             self._device = dd
@@ -250,6 +334,7 @@ class Dataset:
                 "used_features": self.used_features,
                 "feature_names": self.feature_names,
                 "mappers": mappers,
+                "bundles": self.bundles,
             }),
             label=self.metadata.label if self.metadata.label is not None else np.empty(0),
             weight=self.metadata.weight if self.metadata.weight is not None else np.empty(0),
@@ -270,6 +355,13 @@ class Dataset:
         self.feature_names = list(meta["feature_names"])
         self.bin_mappers = [BinMapper.from_state(st) for st in meta["mappers"]]
         self.bins = z["bins"]
+        if meta.get("bundles"):
+            from .efb import bundle_layout
+            self.bundles = [[int(x) for x in g] for g in meta["bundles"]]
+            nb = np.array([self.bin_mappers[f].num_bin
+                           for f in self.used_features], np.int64)
+            self.feat_bundle, self.feat_off, self.bundle_widths = \
+                bundle_layout(self.bundles, nb)
         self.metadata = Metadata(self.num_data)
         if z["label"].size:
             self.metadata.label = z["label"].astype(np.float32)
@@ -293,6 +385,10 @@ class Dataset:
         sub.real_to_inner = self.real_to_inner
         sub.feature_names = self.feature_names
         sub.bins = self.bins[indices]
+        sub.bundles = self.bundles
+        sub.feat_bundle = self.feat_bundle
+        sub.feat_off = self.feat_off
+        sub.bundle_widths = self.bundle_widths
         sub.reference = self
         sub.metadata = Metadata(sub.num_data)
         if self.metadata.label is not None:

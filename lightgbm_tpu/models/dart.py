@@ -31,7 +31,7 @@ class DART(GBDT):
     # -- helpers ------------------------------------------------------------
     def _tree_score_delta(self, model_idx: int, bins, scale: float):
         ta = self._device_trees[model_idx]
-        leaf = predict_leaf_binned(ta, bins, self._dd.nan_bins)
+        leaf = predict_leaf_binned(ta, bins, self._dd.nan_bins, efb=self._dd.efb)
         vals = ta.leaf_value * scale
         return vals[leaf]
 
@@ -67,7 +67,8 @@ class DART(GBDT):
                     -self._tree_score_delta(mi, self._dd.bins, w))
                 for vi, vset in enumerate(self.valid_sets):
                     ta = self._device_trees[mi]
-                    leaf = predict_leaf_binned(ta, vset.device_data().bins, self._dd.nan_bins)
+                    leaf = predict_leaf_binned(ta, vset.device_data().bins,
+                                               self._dd.nan_bins, efb=self._dd.efb)
                     self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
                         -(ta.leaf_value * w)[leaf])
 
@@ -95,7 +96,8 @@ class DART(GBDT):
             self._train_score = self._train_score.at[k].add(
                 self._tree_score_delta(mi, self._dd.bins, adj))
             for vi, vset in enumerate(self.valid_sets):
-                leaf = predict_leaf_binned(ta, vset.device_data().bins, self._dd.nan_bins)
+                leaf = predict_leaf_binned(ta, vset.device_data().bins,
+                                               self._dd.nan_bins, efb=self._dd.efb)
                 self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
                     (ta.leaf_value * adj)[leaf])
 
@@ -111,7 +113,8 @@ class DART(GBDT):
                     self._tree_score_delta(mi, self._dd.bins, new_w))
                 for vi, vset in enumerate(self.valid_sets):
                     ta = self._device_trees[mi]
-                    leaf = predict_leaf_binned(ta, vset.device_data().bins, self._dd.nan_bins)
+                    leaf = predict_leaf_binned(ta, vset.device_data().bins,
+                                               self._dd.nan_bins, efb=self._dd.efb)
                     self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
                         (ta.leaf_value * new_w)[leaf])
         return stop

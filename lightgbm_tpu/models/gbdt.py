@@ -165,6 +165,15 @@ class GBDT:
             raise LightGBMError(
                 "forced splits are not supported with the feature/voting "
                 "parallel tree learners")
+        if tl in ("feature", "voting") and self._dd.efb is not None:
+            # the Dataset disables bundling when its params request these
+            # learners; a dataset constructed for serial/data training and
+            # then reused here would silently misalign per-feature metadata
+            # against bundle columns
+            raise LightGBMError(
+                f"tree_learner={tl} cannot train on an EFB-bundled Dataset; "
+                "construct the Dataset with tree_learner=%s or "
+                "enable_bundle=false in its params" % tl)
         axis = FEATURE_AXIS if tl == "feature" else DATA_AXIS
         self._mesh = default_mesh(n_dev, axis_name=axis)
         self._grower_cfg = self._grower_cfg._replace(
@@ -207,7 +216,10 @@ class GBDT:
             hist_compact_min_cap=cfg.hist_compact_min_cap,
             hist_compact_ladder=cfg.hist_compact_ladder,
             extra_trees=cfg.extra_trees,
-            sorted_cat=sorted_cat)
+            sorted_cat=sorted_cat,
+            bundle_bins=self._dd.bundle_bins,
+            monotone_mode=cfg.monotone_constraints_method,
+            has_monotone=any(v != 0 for v in cfg.monotone_constraints))
 
     # ------------------------------------------------------------------
     # feature-gating state: interaction constraints + CEGB (SURVEY.md §2.4)
@@ -612,7 +624,7 @@ class GBDT:
                                  interaction_sets=inter,
                                  cegb_coupled=cegb_coupled,
                                  cegb_lazy=lazy, cegb_used_data=cegb_used,
-                                 forced=forced)
+                                 forced=forced, efb=dd.efb)
             return fn
 
         # parallel learners: the same grow_tree program under shard_map, with
@@ -671,7 +683,7 @@ class GBDT:
                              dd.default_bins, dd.nan_bins, dd.is_categorical,
                              dd.monotone, key, cfg, interaction_sets=inter,
                              cegb_coupled=cc, cegb_lazy=lazy,
-                             cegb_used_data=cu, forced=forced)
+                             cegb_used_data=cu, forced=forced, efb=dd.efb)
 
         sharded = jax.shard_map(
             grow, mesh=mesh,
@@ -749,7 +761,8 @@ class GBDT:
 
         @jax.jit
         def fn(tree_arrays, bins):
-            return predict_leaf_binned(tree_arrays, bins, dd.nan_bins)
+            return predict_leaf_binned(tree_arrays, bins, dd.nan_bins,
+                                       efb=dd.efb)
         return fn
 
     # ------------------------------------------------------------------
@@ -924,8 +937,10 @@ class GBDT:
 
         has_linear = any(getattr(t, "is_linear", False) for t in self.models)
 
-        def warm(dd, score, raw):
-            bins_np = np.asarray(dd.bins)
+        def warm(ds, dd, score, raw):
+            # host-side binned traversal wants per-feature bins: decode any
+            # EFB bundle columns (io/efb.py)
+            bins_np = ds.unbundled_bins()
             nan_np = np.asarray(dd.nan_bins)
             s = np.array(score, np.float64)
             for t in self.models:
@@ -953,10 +968,11 @@ class GBDT:
 
         # the first tree of the previous model already carries its bias;
         # drop this model's own boost-from-average init
-        self._train_score = warm(self._dd, jnp.zeros_like(self._train_score),
+        self._train_score = warm(self.train_data, self._dd,
+                                 jnp.zeros_like(self._train_score),
                                  raw_of(self.train_data))
         for vi, vset in enumerate(self.valid_sets):
-            self._valid_scores[vi] = warm(vset.device_data(),
+            self._valid_scores[vi] = warm(vset, vset.device_data(),
                                           jnp.zeros_like(self._valid_scores[vi]),
                                           raw_of(vset))
 

@@ -25,6 +25,7 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .histogram import build_histogram, gather_rows, unrolled_rank
 from .split import (NEG_INF, SplitParams, SplitResult, bitset_contains,
@@ -109,6 +110,19 @@ class GrowerConfig(NamedTuple):
     # — when False the sorted-categorical scan is skipped at trace time,
     # removing ~128 sequential tiny ops + 4 argsorts from every split step
     sorted_cat: bool = True
+    # EFB (io/efb.py): histogram width of the BUNDLE columns the kernel sees;
+    # 0 = bins are plain per-feature columns.  Feature-space histograms of
+    # width max_bin are expanded from bundle space before each split search.
+    bundle_bins: int = 0
+    # monotone constraint mode (reference monotone_constraints.hpp):
+    # 'basic' pinches child output bounds at the midpoint;
+    # 'intermediate' bounds children with the ACTUAL sibling outputs and
+    # propagates to overlapping leaves (see apply_split), re-validating each
+    # chosen split against current bounds at apply time.  Only takes effect
+    # when has_monotone is True (static, so unconstrained models trace none
+    # of the machinery).
+    monotone_mode: str = "basic"
+    has_monotone: bool = False
 
 
 class TreeArrays(NamedTuple):
@@ -180,6 +194,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               cegb_lazy: "jax.Array | None" = None,
               cegb_used_data: "jax.Array | None" = None,
               forced: "Tuple[Tuple[int, int, int], ...]" = (),
+              efb: "tuple | None" = None,
               ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree.  Returns (tree, node_assignment[num_data]).
 
@@ -200,16 +215,87 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         a forced split that fails its validity gates (skipped, as the
         reference erases negative-gain forced splits from forceSplitMap)
         does not shift later forced splits' leaf numbering.
+      efb: static ``(feat_bundle [F], feat_off [F], num_bins [F])`` numpy
+        arrays when ``bins`` is an EFB bundle matrix (io/efb.py): histograms
+        are built and stored in bundle space and expanded to feature space
+        for each split search; the split column decodes through the uniform
+        ``col - off + 1`` mapping (identity for singleton bundles).
     """
-    n, f = bins.shape
+    n, n_cols = bins.shape
+    if efb is not None:
+        efb_bundle_np, efb_off_np, efb_nb_np = efb
+        f = int(efb_bundle_np.shape[0])
+        if cfg.parallel_mode in ("feature", "voting"):
+            raise NotImplementedError(
+                "EFB is not supported with feature/voting parallel learners")
+    else:
+        f = n_cols
     L = cfg.num_leaves
-    B = cfg.max_bin
+    B = cfg.max_bin                    # feature-space histogram width
+    Bb = cfg.bundle_bins or B          # kernel (bundle-column) width
     cw = cat_words(B)
     p = cfg.split
     axis = cfg.axis_name
     mode = cfg.parallel_mode or ("data" if axis is not None else None)
 
-    # --- feature-parallel bookkeeping: features sharded over the axis -------
+    # ---- EFB decode tables (identity when efb is None) ---------------------
+    # split-column mapping: feature bin = col - off + 1 when
+    # off <= col < off + (nb-1), else 0.  With off = 1 and col the feature's
+    # own column this is the identity, so ONE code path serves both layouts.
+    if efb is not None:
+        col_of_feat = jnp.asarray(efb_bundle_np.astype(np.int32))
+        off_of_feat = jnp.asarray(efb_off_np.astype(np.int32))
+        # static gather indices: hist_f[f, b] = hist_b[bundle_f, off_f+b-1]
+        _spans = efb_nb_np.astype(np.int64) - 1
+        _bidx = np.arange(B - 1, dtype=np.int64)[None, :]
+        _valid = _bidx < _spans[:, None]
+        _idx = (efb_bundle_np.astype(np.int64)[:, None] * Bb
+                + efb_off_np.astype(np.int64)[:, None] + _bidx)
+        _idx = np.where(_valid, _idx, 0)
+        _efb_idx = jnp.asarray(_idx.reshape(-1).astype(np.int32))
+        _efb_valid = jnp.asarray(_valid.astype(np.float32))
+        _efb_bundle = jnp.asarray(efb_bundle_np.astype(np.int32))
+
+        def expand_hist(hb):
+            """[n_cols, Bb, 3] bundle hists -> [F, B, 3] feature hists
+            (bin 0 recovered as total-minus-rest: the reference's
+            FixHistogram, dataset.cpp:1239)."""
+            flat = hb.reshape(-1, 3)
+            g = jnp.take(flat, _efb_idx, axis=0).reshape(f, B - 1, 3)
+            g = g * _efb_valid[:, :, None]
+            totals = jnp.sum(hb, axis=1)                       # [n_cols, 3]
+            bin0 = jnp.take(totals, _efb_bundle, axis=0) - jnp.sum(g, axis=1)
+            return jnp.concatenate([bin0[:, None, :], g], axis=1)
+    else:
+        col_of_feat = off_of_feat = None
+
+        def expand_hist(hb):
+            return hb
+
+    def split_column_bins(colv_raw, feat):
+        """Decode a gathered (bundle) column into feature bins for ``feat``."""
+        if efb is None:
+            return colv_raw
+        from ..io.efb import decode_bundle_column
+        return decode_bundle_column(colv_raw, off_of_feat[feat],
+                                    num_bins[feat]).astype(jnp.int32)
+
+    # --- data-parallel comm shape: reduce-scatter + sharded search ----------
+    # Instead of allreducing the full [F, B, 3] histogram per split, each
+    # shard receives (and stores, and searches) only its OWN feature block:
+    # lax.psum_scatter moves F*B/ndev per device where a psum moved F*B, and
+    # the winning SplitInfo rides the existing _reduce_split_global pmax —
+    # the reference DataParallelTreeLearner dataflow (ReduceScatter +
+    # SyncUpGlobalBestSplit, data_parallel_tree_learner.cpp:155-251).
+    # Falls back to the full psum for the paths that need a full-width
+    # histogram store on every shard (EFB bundles, forced splits, CEGB-lazy).
+    dp_scatter = (mode == "data" and efb is None and not forced
+                  and cegb_lazy is None and cfg.num_shards > 1)
+    if dp_scatter:
+        shard_w = -(-f // cfg.num_shards)        # owned features per shard
+        shard_wp = shard_w * cfg.num_shards
+
+    # --- sharded-search bookkeeping (feature-parallel + data-scatter) -------
     # metadata arrays arrive FULL-width [F_total]; the histogram axis is the
     # local shard.  Local slices feed the split search, full arrays feed the
     # partition step (which sees the globally-reduced winning feature id).
@@ -225,6 +311,19 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         is_cat_l = lslice(is_categorical)
         mono_l = lslice(monotone)
         f_full = feature_mask.shape[0]
+    elif dp_scatter:
+        dev = jax.lax.axis_index(axis)
+        f_start = dev * shard_w
+
+        def lslice(a, fill):
+            ap = jnp.pad(a, (0, shard_wp - f), constant_values=fill)
+            return jax.lax.dynamic_slice_in_dim(ap, f_start, shard_w)
+        num_bins_l = lslice(num_bins, 1)
+        default_bins_l = lslice(default_bins, 0)
+        nan_bins_l = lslice(nan_bins, -1)
+        is_cat_l = lslice(is_categorical, False)
+        mono_l = lslice(monotone, 0)
+        f_full = f
     else:
         num_bins_l, default_bins_l, nan_bins_l = num_bins, default_bins, nan_bins
         is_cat_l, mono_l = is_categorical, monotone
@@ -276,8 +375,19 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         """[cap, 3] f32 (grad, hess, row_weight) back out of a gathered
         combined block."""
         cap = combb.shape[0]
-        raw = combb[:, f:].reshape(cap, 3, _gh_cols // 3)
+        raw = combb[:, n_cols:].reshape(cap, 3, _gh_cols // 3)
         return jax.lax.bitcast_convert_type(raw, jnp.float32)
+
+    def reduce_hist(h):
+        """Join shard-local histograms: reduce-scatter to the owned feature
+        block (dp_scatter) or full allreduce.  No-op outside data mode."""
+        if mode != "data":
+            return h
+        if dp_scatter:
+            hp = jnp.pad(h, ((0, shard_wp - n_cols), (0, 0), (0, 0)))
+            return jax.lax.psum_scatter(hp, axis, scatter_dimension=0,
+                                        tiled=True)
+        return jax.lax.psum(h, axis)
 
     def partition_and_hist(perm, begin, rows, feat, thr, dleft, f_is_cat,
                            cbits, ok, left_smaller):
@@ -296,13 +406,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             def br(perm):
                 start, off = _seg_window(begin, cap)
                 seg = jax.lax.dynamic_slice(perm, (start,), (cap,))
-                combb = jnp.take(comb, seg, axis=0)       # [cap, F+gh_cols]
+                combb = jnp.take(comb, seg, axis=0)       # [cap, NC+gh_cols]
                 ghb = _unpack_gh(combb)                   # [cap, 3]
                 # split column via one-hot reduce — a dynamic minor-axis
                 # take would relayout the whole block
-                fsel = (jnp.arange(combb.shape[1], dtype=jnp.int32) == feat)
-                colv = jnp.sum(combb.astype(jnp.int32) * fsel[None, :],
-                               axis=1)
+                col_id = col_of_feat[feat] if efb is not None else feat
+                fsel = (jnp.arange(combb.shape[1], dtype=jnp.int32) == col_id)
+                colv = split_column_bins(
+                    jnp.sum(combb.astype(jnp.int32) * fsel[None, :], axis=1),
+                    feat)
                 is_miss = (colv == nan_bins[feat]) & (nan_bins[feat] >= 0)
                 gl = jnp.where(f_is_cat, bitset_contains(cbits, colv),
                                jnp.where(is_miss, dleft, colv <= thr))
@@ -327,22 +439,20 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 # histogram the WHOLE combined block: the gh byte-columns
                 # histogram garbage that is sliced off below — cheaper than
                 # a minor-axis slice relayout of the block
-                h = build_histogram(combb, ghb[:, 0], ghb[:, 1], m, B,
+                h = build_histogram(combb, ghb[:, 0], ghb[:, 1], m, Bb,
                                     method=cfg.hist_method,
                                     chunk_rows=cfg.hist_chunk_rows)
-                return new_perm, nleft, h[:f]
+                return new_perm, nleft, h[:n_cols]
             return br
         idx = jnp.searchsorted(jnp.asarray(caps, jnp.int32), rows)
         new_perm, nleft, h = jax.lax.switch(idx, [mk(c) for c in caps], perm)
-        if mode == "data":
-            # collective stays OUTSIDE the data-dependent switch: shards may
-            # pick different buckets, all join here
-            h = jax.lax.psum(h, axis)
-        return new_perm, nleft, h
+        # collective stays OUTSIDE the data-dependent switch: shards may
+        # pick different buckets, all join here
+        return new_perm, nleft, reduce_hist(h)
 
     def hist_of(mask, nrows=None):
         def full(m):
-            return build_histogram(bins, grad, hess, m, B,
+            return build_histogram(bins, grad, hess, m, Bb,
                                    method=cfg.hist_method,
                                    chunk_rows=cfg.hist_chunk_rows)
 
@@ -352,7 +462,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             def mk(cap):
                 def br(m):
                     bc, gc, hc, mc = gather_rows(bins, grad, hess, m, cap)
-                    return build_histogram(bc, gc, hc, mc, B,
+                    return build_histogram(bc, gc, hc, mc, Bb,
                                            method=cfg.hist_method,
                                            chunk_rows=cfg.hist_chunk_rows)
                 return br
@@ -360,11 +470,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             idx = jnp.searchsorted(jnp.asarray(caps, jnp.int32),
                                    nrows.astype(jnp.int32))
             h = jax.lax.switch(idx, branches, mask)
-        if mode == "data":
-            # collective stays OUTSIDE the data-dependent switch: shards may
-            # pick different buckets, all join here
-            h = jax.lax.psum(h, axis)
-        return h
+        # collective stays OUTSIDE the data-dependent switch: shards may
+        # pick different buckets, all join here
+        return reduce_hist(h)
 
     def node_feature_mask(step):
         if cfg.feature_fraction_bynode >= 1.0:
@@ -390,10 +498,16 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
              lo=NEG_INF, hi=-NEG_INF, penalty=None, rand=None):
         """Mode-dispatched best-split search (the analog of the reference's
         learner-specific FindBestSplitsFromHistograms overrides)."""
-        if mode == "feature":
-            fmask_l = jax.lax.dynamic_slice_in_dim(fmask, f_start, f)
-            pen_l = (jax.lax.dynamic_slice_in_dim(penalty, f_start, f)
-                     if penalty is not None else None)
+        if mode == "feature" or dp_scatter:
+            w = f if mode == "feature" else shard_w
+
+            def lsl(a):
+                if dp_scatter:
+                    a = jnp.pad(a, (0, shard_wp - a.shape[0]))
+                return jax.lax.dynamic_slice_in_dim(a, f_start, w)
+            fmask_l = lsl(fmask)
+            pen_l = lsl(penalty) if penalty is not None else None
+            # rand_thresholds is built from num_bins_l: already shard-local
             s = find_best_split(hist, num_bins_l, default_bins_l, nan_bins_l,
                                 is_cat_l, mono_l, sum_g, sum_h, count, p,
                                 fmask_l, parent_output, lo, hi, pen_l, rand,
@@ -441,6 +555,20 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                is_cat_l, mono_l, sum_g, sum_h, count, p,
                                emask, parent_output, lo, hi, penalty, rand,
                                sorted_cat=cfg.sorted_cat)
+
+    # monotone 'intermediate' (reference IntermediateLeafConstraints,
+    # monotone_constraints.hpp:514): output bounds come from the ACTUAL
+    # sibling outputs instead of the midpoint, and tighten OTHER leaves
+    # whose bin-rectangles overlap the new children in every non-split
+    # dimension.  The overlap test is a vectorized superset of the
+    # reference's contiguity tree-walk (GoUpToFindLeavesToUpdate): sound —
+    # every constraint it adds is implied by monotonicity — at worst
+    # slightly more constraining, and it trades the data-dependent
+    # recursion for one [L, F] broadcast per split.  Cached best splits can
+    # go stale when bounds tighten, so the growth loop re-validates the
+    # chosen leaf's split against current bounds before applying (the
+    # analog of RecomputeBestSplitForLeaf, serial_tree_learner.cpp:673-681).
+    mono_inter = cfg.has_monotone and cfg.monotone_mode == "intermediate"
 
     use_cegb = (cegb_coupled is not None or cegb_lazy is not None
                 or cfg.cegb_split_penalty > 0.0)
@@ -513,10 +641,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             rw_pos, tot[2],
             jnp.zeros(f_full, bool) if cegb_coupled is not None else None,
             cegb_used_data)
-    root_split = find(root_hist, tot[0], tot[1], tot[2], fmask0, penalty=pen0,
-                      rand=rand_thresholds(0))
+    root_split = find(expand_hist(root_hist), tot[0], tot[1], tot[2], fmask0,
+                      penalty=pen0, rand=rand_thresholds(0))
 
-    hist_store = jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist)
+    # histogram store stays in BUNDLE space (subtraction is linear there);
+    # searches expand to feature space on the fly.  Under dp_scatter each
+    # shard stores only its owned feature block: memory / num_shards.
+    store_w = shard_w if dp_scatter else n_cols
+    hist_store = jnp.zeros((L, store_w, Bb, 3), jnp.float32).at[0].set(root_hist)
     best = _BestSplits.empty(L, cw).set_leaf(0, root_split)
     # depth gate for root handled trivially (max_depth >= 1 always allows root)
 
@@ -550,6 +682,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         state["leaf_nrows"] = jnp.zeros(L, jnp.int32).at[0].set(n)
     else:
         state["node_assign"] = jnp.zeros(n, jnp.int32)
+    if mono_inter:
+        # per-leaf bin rectangles for the overlap-propagation pass
+        state["rect_lo"] = jnp.zeros((L, f_full), jnp.int32)
+        state["rect_hi"] = jnp.full((L, f_full), B - 1, jnp.int32)
     if interaction_sets is not None:
         state["leaf_branch"] = jnp.zeros((L, f_full), jnp.float32)
     if cegb_coupled is not None:
@@ -561,7 +697,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         """SplitInfo for a forced (feature, threshold-bin) split of a leaf,
         from its stored histogram (the reference's
         ``GatherInfoForThreshold``, feature_histogram.hpp)."""
-        h = st["hist"][leaf][feat]                                   # [B, 3]
+        h = expand_hist(st["hist"][leaf])[feat]                      # [B, 3]
         total = jnp.stack([st["leaf_sum_g"][leaf], st["leaf_weight"][leaf],
                            st["leaf_count"][leaf]])
         bin_ids = jnp.arange(B)
@@ -665,7 +801,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 owns = (feat >= f_start) & (feat < f_start + f)
                 col = jnp.take(bins, local_ix, axis=1).astype(jnp.int32)
             else:
-                col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+                col_id = col_of_feat[feat] if efb is not None else feat
+                col = split_column_bins(
+                    jnp.take(bins, col_id, axis=1).astype(jnp.int32), feat)
             is_miss = (col == nan_bins[feat]) & (nan_bins[feat] >= 0)
             goes_left = jnp.where(
                 f_is_cat, bitset_contains(cbits, col),
@@ -703,17 +841,75 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         leaf_is_left = setw(setw(st["leaf_is_left"], leaf, True),
                             new_id, False)
 
-        # monotone (basic): children inherit bounds; split on a monotone
-        # feature pinches them at the midpoint of the child outputs
         mono = monotone[feat]
         lo, hi = st["leaf_lo"][leaf], st["leaf_hi"][leaf]
-        mid = (b.lout[leaf] + b.rout[leaf]) * 0.5
-        l_lo = jnp.where(mono < 0, jnp.maximum(lo, mid), lo)
-        l_hi = jnp.where(mono > 0, jnp.minimum(hi, mid), hi)
-        r_lo = jnp.where(mono > 0, jnp.maximum(lo, mid), lo)
-        r_hi = jnp.where(mono < 0, jnp.minimum(hi, mid), hi)
+        is_num = ~f_is_cat
+        if mono_inter:
+            # intermediate: children bounded by the ACTUAL sibling outputs
+            # (UpdateConstraintsWithOutputs, monotone_constraints.hpp:543)
+            lo_out, ro_out = b.lout[leaf], b.rout[leaf]
+            l_lo = jnp.where(is_num & (mono < 0), jnp.maximum(lo, ro_out), lo)
+            l_hi = jnp.where(is_num & (mono > 0), jnp.minimum(hi, ro_out), hi)
+            r_lo = jnp.where(is_num & (mono > 0), jnp.maximum(lo, lo_out), lo)
+            r_hi = jnp.where(is_num & (mono < 0), jnp.minimum(hi, lo_out), hi)
+        else:
+            # basic: pinch both children at the midpoint of the child outputs
+            mid = (b.lout[leaf] + b.rout[leaf]) * 0.5
+            l_lo = jnp.where(mono < 0, jnp.maximum(lo, mid), lo)
+            l_hi = jnp.where(mono > 0, jnp.minimum(hi, mid), hi)
+            r_lo = jnp.where(mono > 0, jnp.maximum(lo, mid), lo)
+            r_hi = jnp.where(mono < 0, jnp.minimum(hi, mid), hi)
         leaf_lo = setw(setw(st["leaf_lo"], leaf, l_lo), new_id, r_lo)
         leaf_hi = setw(setw(st["leaf_hi"], leaf, l_hi), new_id, r_hi)
+
+        extra_mono = {}
+        if mono_inter:
+            # children rectangles: a numeric split partitions dimension
+            # `feat` at thr; categorical children conservatively keep the
+            # parent rect (more overlaps -> never fewer constraints)
+            fsel = jnp.arange(f_full, dtype=jnp.int32) == feat
+            prl, prh = st["rect_lo"][leaf], st["rect_hi"][leaf]      # [F]
+            l_rh = jnp.where(fsel & is_num, thr, prh)
+            r_rl = jnp.where(fsel & is_num, thr + 1, prl)
+            rect_lo = setw(setw(st["rect_lo"], leaf, prl), new_id, r_rl)
+            rect_hi = setw(setw(st["rect_hi"], leaf, l_rh), new_id, prh)
+            extra_mono = dict(rect_lo=rect_lo, rect_hi=rect_hi)
+
+            # Propagate the new child outputs to every active leaf that
+            # overlaps a child in all dims except SOME monotone dim k and
+            # sits strictly to one side of it along k — for ANY monotone k,
+            # not just the split feature: the reference's up-walk crosses
+            # every monotone ancestor boundary regardless of what feature
+            # the triggering split used (GoUpToFindLeavesToUpdate).
+            lid = jnp.arange(L, dtype=jnp.int32)
+            is_active = lid <= st["num_leaves"]      # incl. the new leaf slot
+            do_prop = gate(jnp.asarray(True))
+            mono_f = monotone.astype(jnp.int32)                  # [F]
+
+            def prop(llo, lhi, c_lo_row, c_hi_row, out_c):
+                ovl_d = ((rect_lo <= c_hi_row[None, :])
+                         & (rect_hi >= c_lo_row[None, :]))       # [L, F]
+                miss_cnt = jnp.sum(~ovl_d, axis=1)               # [L]
+                # overlap in all dims except k: no misses, or the only miss
+                # is dim k itself
+                ovl_exc = ((miss_cnt == 0)[:, None]
+                           | ((miss_cnt == 1)[:, None] & ~ovl_d))  # [L, F]
+                m_right = rect_lo > c_hi_row[None, :]            # [L, F]
+                m_left = rect_hi < c_lo_row[None, :]
+                raise_lo = jnp.any(
+                    ovl_exc & ((mono_f > 0)[None, :] & m_right
+                               | (mono_f < 0)[None, :] & m_left), axis=1)
+                drop_hi = jnp.any(
+                    ovl_exc & ((mono_f > 0)[None, :] & m_left
+                               | (mono_f < 0)[None, :] & m_right), axis=1)
+                llo = jnp.where(do_prop & is_active & raise_lo,
+                                jnp.maximum(llo, out_c), llo)
+                lhi = jnp.where(do_prop & is_active & drop_hi,
+                                jnp.minimum(lhi, out_c), lhi)
+                return llo, lhi
+
+            leaf_lo, leaf_hi = prop(leaf_lo, leaf_hi, prl, l_rh, lo_out)
+            leaf_lo, leaf_hi = prop(leaf_lo, leaf_hi, r_rl, prh, ro_out)
 
         # --- feature-gating state: interaction branch sets, CEGB ---
         extra = {}
@@ -779,13 +975,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                               cegb_penalty(rmask, c2[1], feat_used, used_data)])
             s2 = jax.vmap(
                 lambda hc, g_, h_, c_, lo_, hi_, pen_: find(
-                    hc, g_, h_, c_, fmask, 0.0, lo_, hi_, penalty=pen_,
-                    rand=rand)
+                    expand_hist(hc), g_, h_, c_, fmask, 0.0, lo_, hi_,
+                    penalty=pen_, rand=rand)
             )(hist2, g2, h2, c2, lo2, hi2, pen2)
         else:
             s2 = jax.vmap(
                 lambda hc, g_, h_, c_, lo_, hi_: find(
-                    hc, g_, h_, c_, fmask, 0.0, lo_, hi_, rand=rand)
+                    expand_hist(hc), g_, h_, c_, fmask, 0.0, lo_, hi_,
+                    rand=rand)
             )(hist2, g2, h2, c2, lo2, hi2)
         s2 = s2._replace(gain=jnp.where(depth_ok, s2.gain, NEG_INF))
         sl = jax.tree.map(lambda a: a[0], s2)
@@ -795,6 +992,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return dict(
             **extra,
             **extra_part,
+            **extra_mono,
             hist=hist, best=best,
             leaf_depth=leaf_depth, leaf_value=leaf_value,
             leaf_count=leaf_count, leaf_weight=leaf_weight,
@@ -868,8 +1066,39 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         active = jnp.where(jnp.arange(L) < st["num_leaves"],
                            st["best"].gain, NEG_INF)
         leaf = jnp.argmax(active).astype(jnp.int32)
-        st = apply_split(jj, st, leaf, active[leaf], None)
-        return jj + 1, st
+        if not mono_inter:
+            st = apply_split(jj, st, leaf, active[leaf], None)
+            return jj + 1, st
+        # intermediate monotone mode: the cached split may violate bounds
+        # tightened since it was found — re-search against CURRENT bounds
+        # (RecomputeBestSplitForLeaf analog), with the same feature gates
+        # the cached search had (interaction branch mask, CEGB penalties).
+        # A leaf whose re-search finds nothing is retired (gain -> NEG_INF)
+        # without consuming a node slot.
+        fmask_j = node_feature_mask(jj)
+        if interaction_sets is not None:
+            fmask_j = fmask_j * interaction_allowed(st["leaf_branch"][leaf])
+        pen_j = None
+        if use_cegb:
+            lm = None
+            if cegb_lazy is not None:
+                lm = jnp.where(st["node_assign"] == leaf, rw_pos, 0.0)
+            pen_j = cegb_penalty(
+                lm, st["leaf_count"][leaf],
+                st["feat_used"] if cegb_coupled is not None else None,
+                st["used_data"] if cegb_lazy is not None else None)
+        s_new = find(expand_hist(st["hist"][leaf]), st["leaf_sum_g"][leaf],
+                     st["leaf_weight"][leaf], st["leaf_count"][leaf],
+                     fmask_j, 0.0,
+                     st["leaf_lo"][leaf], st["leaf_hi"][leaf],
+                     penalty=pen_j, rand=rand_thresholds(jj))
+        depth_ok = (cfg.max_depth <= 0) | (st["leaf_depth"][leaf]
+                                           < cfg.max_depth)
+        s_new = s_new._replace(gain=jnp.where(depth_ok, s_new.gain, NEG_INF))
+        st = dict(st, best=st["best"].set_leaf(leaf, s_new))
+        ok = s_new.gain > 0.0
+        st = apply_split(jj, st, leaf, s_new.gain, ok)
+        return jj + ok.astype(jnp.int32), st
 
     _, state = jax.lax.while_loop(
         loop_cond, loop_body, (state["num_leaves"] - 1, state))

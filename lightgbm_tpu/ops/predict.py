@@ -13,10 +13,18 @@ import jax.numpy as jnp
 from .grower import TreeArrays
 
 
-def predict_leaf_binned(tree: TreeArrays, bins: jax.Array, nan_bins: jax.Array
-                        ) -> jax.Array:
-    """Leaf index per row for binned features ``[N, F]``."""
+def predict_leaf_binned(tree: TreeArrays, bins: jax.Array, nan_bins: jax.Array,
+                        efb=None) -> jax.Array:
+    """Leaf index per row for binned features ``[N, F]``.
+
+    ``efb``: optional static ``(feat_bundle, feat_off, num_bins)`` arrays
+    when ``bins`` is an EFB bundle matrix (io/efb.py) — the per-feature bin
+    decodes through the uniform ``col - off + 1`` range mapping."""
     n = bins.shape[0]
+    if efb is not None:
+        fb = jnp.asarray(efb[0].astype("int32"))
+        fo = jnp.asarray(efb[1].astype("int32"))
+        fnb = jnp.asarray(efb[2].astype("int32"))
 
     def cond(cur):
         return jnp.any(cur >= 0)
@@ -24,8 +32,13 @@ def predict_leaf_binned(tree: TreeArrays, bins: jax.Array, nan_bins: jax.Array
     def body(cur):
         node = jnp.maximum(cur, 0)
         feat = tree.split_feature[node]                      # [N]
-        col = jnp.take_along_axis(bins, feat[:, None].astype(jnp.int32), axis=1
-                                  )[:, 0].astype(jnp.int32)  # [N]
+        col_id = jnp.take(fb, feat) if efb is not None else feat
+        col = jnp.take_along_axis(bins, col_id[:, None].astype(jnp.int32),
+                                  axis=1)[:, 0].astype(jnp.int32)  # [N]
+        if efb is not None:
+            from ..io.efb import decode_bundle_column
+            col = decode_bundle_column(col, jnp.take(fo, feat),
+                                       jnp.take(fnb, feat)).astype(jnp.int32)
         thr = tree.threshold[node]
         is_cat = tree.is_cat_split[node]
         dleft = tree.default_left[node]

@@ -8,13 +8,24 @@ histogram buffer so each rank owns full histograms for a feature block
 serialized ``SplitInfo`` (``parallel_tree_learner.h:191-214``).
 
 Here the same dataflow is one `shard_map` program: the grower runs on each
-shard with ``GrowerConfig.axis_name`` set, so its histogram and root-sum
-reductions are ``lax.psum`` collectives; every device then holds identical
-global histograms and computes the identical best split (no SplitInfo
-serialization, no second allreduce — the argmax is replicated compute over
-the psum'd histogram, which over ICI is cheaper than the reference's
-two-phase scheme over ethernet).  The per-shard ``node_assignment`` update
-stays local, exactly like the reference's local ``DataPartition::Split``.
+shard with ``GrowerConfig.axis_name`` set, and the reference's dataflow maps
+onto collectives exactly (ops/grower.py ``reduce_hist`` /
+``_reduce_split_global``):
+
+- per split, local histograms join via ``lax.psum_scatter`` over the feature
+  axis, so each shard RECEIVES, STORES and SEARCHES only its owned feature
+  block — comm volume F*B/ndev per device per split (a full ``psum`` moves
+  F*B and was the round-2 shape), and the histogram-subtraction store
+  shrinks by 1/ndev too;
+- each shard's local best split then rides a tiny ``pmax``-based SplitInfo
+  allreduce (``_reduce_split_global`` = SyncUpGlobalBestSplit), after which
+  every shard applies the identical split to its local rows — the
+  reference's local ``DataPartition::Split``.
+
+Paths that need a full-width histogram on every shard (EFB bundle
+expansion, forced splits, CEGB-lazy) fall back to the full ``psum``.
+``scripts/bench_dp_scaling.py`` measures the 1..8-shard curve on the
+virtual CPU mesh.
 """
 from __future__ import annotations
 

@@ -1,0 +1,44 @@
+"""Data-parallel scaling curve on the virtual CPU mesh.
+
+The virtual mesh shares one host's cores, so this measures the COMM/compute
+structure (and that more shards do not regress the program), not real ICI
+speedup — the reference's real-cluster curve is BASELINE.md's Criteo table.
+
+usage: python scripts/bench_dp_scaling.py [rows] [features] [leaves]
+"""
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import numpy as np   # noqa: E402
+
+rows = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+feats = int(sys.argv[2]) if len(sys.argv) > 2 else 28
+leaves = int(sys.argv[3]) if len(sys.argv) > 3 else 63
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import lightgbm_tpu as lgb   # noqa: E402
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(rows, feats)).astype(np.float32)
+y = (X[:, 0] + X[:, 1] * X[:, 2] + rng.logistic(size=rows) > 0).astype(np.float32)
+
+for ndev in (1, 2, 4, 8):
+    params = {"objective": "binary", "num_leaves": leaves, "verbose": -1,
+              "tree_learner": "data" if ndev > 1 else "serial",
+              "mesh_shape": [ndev] if ndev > 1 else None,
+              "min_data_in_leaf": 50}
+    train = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params=params, train_set=train)
+    bst.update()                                # compile
+    bst._gbdt._train_score.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        bst.update()
+    bst._gbdt._train_score.block_until_ready()
+    dt = (time.perf_counter() - t0) / 5
+    print(f"shards={ndev}:  {dt*1e3:8.1f} ms/tree")

@@ -103,3 +103,71 @@ def test_cegb_scores_differ(regression_data):
         pen = lgb.train({"objective": "regression", "num_leaves": 15,
                          "verbose": -1, **extra}, ds, num_boost_round=5)
         assert not np.allclose(pen.predict(X), base.predict(X)), extra
+
+
+# ---------------------------------------------------------------------------
+# monotone constraints — intermediate mode (IntermediateLeafConstraints,
+# reference monotone_constraints.hpp:514; vectorized rectangle propagation)
+def _monotone_violation(bst, X, fidx, sign, grid_lo=-2, grid_hi=2):
+    """Max violation of sign-monotonicity in feature ``fidx`` over a sweep."""
+    base = X[:200].copy()
+    prev, worst = None, 0.0
+    for v in np.linspace(grid_lo, grid_hi, 50):
+        b = base.copy()
+        b[:, fidx] = v
+        p = bst.predict(b)
+        if prev is not None:
+            worst = max(worst, float(np.max(sign * (prev - p))))
+        prev = p
+    return worst
+
+
+def _monotone_fixture(seed=0, n=4000):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, (n, 4))
+    y = (1.5 * X[:, 0] + np.sin(2 * X[:, 1]) + 0.3 * X[:, 2] ** 2
+         - 0.8 * X[:, 3] + rng.normal(0, 0.2, n))
+    return X, y
+
+
+def _train_monotone(X, y, method, cons=(1, 0, 0, -1), rounds=25):
+    ds = lgb.Dataset(X, label=y)
+    return lgb.train({"objective": "regression", "num_leaves": 63,
+                      "verbose": -1, "monotone_constraints": list(cons),
+                      "monotone_constraints_method": method,
+                      "min_data_in_leaf": 20}, ds, rounds)
+
+
+def test_monotone_intermediate_preserves_monotonicity():
+    X, y = _monotone_fixture()
+    bst = _train_monotone(X, y, "intermediate")
+    assert _monotone_violation(bst, X, 0, +1) <= 1e-10
+    assert _monotone_violation(bst, X, 3, -1) <= 1e-10
+
+
+def test_monotone_intermediate_less_constraining_than_basic():
+    """Intermediate bounds children by actual sibling outputs instead of the
+    midpoint, so it finds splits basic rejects -> strictly better fit here."""
+    X, y = _monotone_fixture()
+    basic = _train_monotone(X, y, "basic")
+    inter = _train_monotone(X, y, "intermediate")
+    l2_basic = float(np.mean((basic.predict(X) - y) ** 2))
+    l2_inter = float(np.mean((inter.predict(X) - y) ** 2))
+    assert l2_inter < l2_basic
+    assert not np.allclose(basic.predict(X[:100]), inter.predict(X[:100]))
+
+
+def test_monotone_advanced_falls_back_to_intermediate():
+    X, y = _monotone_fixture(seed=1)
+    bst = _train_monotone(X, y, "advanced")
+    assert _monotone_violation(bst, X, 0, +1) <= 1e-10
+
+
+def test_monotone_intermediate_multiclass_and_depth():
+    X, y = _monotone_fixture(seed=2)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "max_depth": 4, "verbose": -1,
+                     "monotone_constraints": [1, 0, 0, 0],
+                     "monotone_constraints_method": "intermediate"}, ds, 10)
+    assert _monotone_violation(bst, X, 0, +1) <= 1e-10
