@@ -309,7 +309,9 @@ class Config:
     # DataPartition index ranges) instead of full-dataset masking
     hist_compact: bool = True
     hist_compact_min_cap: int = 8192          # smallest gather bucket
-    hist_compact_ladder: int = 2              # bucket growth factor (2 or 4)
+    # bucket growth factor (>= 1.2): 1.41 benched ~10% faster trees than 2
+    # on v5e (half the round-up waste) for ~30% more compile time
+    hist_compact_ladder: float = 1.41
     mesh_shape: List[int] = field(default_factory=list)   # device mesh, [] = all devices on one axis
     pred_device: str = "auto"                 # auto | device | host ensemble predict
     donate_state: bool = True

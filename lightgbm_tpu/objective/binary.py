@@ -33,10 +33,12 @@ class BinaryLogloss(ObjectiveFunction):
             Log.warning("Contains only one class")
         # is_unbalance: weight classes inversely to frequency (binary_objective.hpp:70)
         if self.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
+            # the MINORITY class is weighted up (binary_objective.hpp:82-89:
+            # label_weights_[1] is the positive-class weight)
             if cnt_pos > cnt_neg:
-                self.label_weights = (1.0, cnt_pos / cnt_neg)
+                self.label_weights = (cnt_pos / cnt_neg, 1.0)
             else:
-                self.label_weights = (cnt_neg / cnt_pos, 1.0)
+                self.label_weights = (1.0, cnt_neg / cnt_pos)
         else:
             self.label_weights = (1.0, self.scale_pos_weight)
         self.cnt_pos, self.cnt_neg = cnt_pos, cnt_neg

@@ -100,9 +100,11 @@ class GrowerConfig(NamedTuple):
     # sibling's rows into the tightest power-of-4 bucket before histogramming
     hist_compact: bool = True
     hist_compact_min_cap: int = 8192
-    # capacity-ladder growth factor: 2 halves average bucket round-up waste
-    # vs 4 at the cost of ~2x more switch branches to compile
-    hist_compact_ladder: int = 2
+    # capacity-ladder growth factor: smaller factors shrink the average
+    # bucket round-up waste (expected waste ~ (ladder-1)/2 of every gathered
+    # segment) at the cost of more switch branches to compile; fractional
+    # values are allowed (caps round up to 1024-multiples)
+    hist_compact_ladder: float = 2
     # extremely-randomized trees: one random threshold per feature per node
     # (reference USE_RAND, feature_histogram.hpp:115-217)
     extra_trees: bool = False
@@ -336,9 +338,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     caps: "list[int]" = []
     if cfg.hist_compact:
         c = min(cfg.hist_compact_min_cap, n)
+        factor = max(1.2, float(cfg.hist_compact_ladder))
         while c < n:
             caps.append(c)
-            c *= max(2, cfg.hist_compact_ladder)
+            c = max(c + 1024, -(-int(c * factor) // 1024) * 1024)
     caps.append(n)
 
     # Row-partition mode: maintain a permutation of local rows grouped by
@@ -436,12 +439,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 new_perm = jax.lax.dynamic_update_slice(perm, new_seg,
                                                         (start,))
                 m = jnp.where(valid & (gl == left_smaller), ghb[:, 2], 0.0)
-                # histogram the WHOLE combined block: the gh byte-columns
-                # histogram garbage that is sliced off below — cheaper than
-                # a minor-axis slice relayout of the block
+                # histogram the combined block in place: the pallas kernel
+                # skips the gh byte-columns via f_limit, the XLA fallbacks
+                # histogram them as garbage and the [:n_cols] slice drops it
+                # — either way cheaper than a minor-axis slice relayout
                 h = build_histogram(combb, ghb[:, 0], ghb[:, 1], m, Bb,
                                     method=cfg.hist_method,
-                                    chunk_rows=cfg.hist_chunk_rows)
+                                    chunk_rows=cfg.hist_chunk_rows,
+                                    f_limit=n_cols)
                 return new_perm, nleft, h[:n_cols]
             return br
         idx = jnp.searchsorted(jnp.asarray(caps, jnp.int32), rows)
@@ -686,6 +691,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # per-leaf bin rectangles for the overlap-propagation pass
         state["rect_lo"] = jnp.zeros((L, f_full), jnp.int32)
         state["rect_hi"] = jnp.full((L, f_full), B - 1, jnp.int32)
+        # the step whose per-node feature mask / extra-trees thresholds the
+        # leaf's cached best split was searched under: the re-validation
+        # must re-key with the SAME step, not resample
+        state["leaf_step"] = jnp.zeros(L, jnp.int32)
     if interaction_sets is not None:
         state["leaf_branch"] = jnp.zeros((L, f_full), jnp.float32)
     if cegb_coupled is not None:
@@ -988,6 +997,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         sl = jax.tree.map(lambda a: a[0], s2)
         sr = jax.tree.map(lambda a: a[1], s2)
         best = cur_best.set_leaf(leaf, sl, ok).set_leaf(new_id, sr, ok)
+        if mono_inter:
+            # both children's cached splits were searched under step j+1's
+            # mask/thresholds (see fmask/rand above)
+            jt = jnp.asarray(j, jnp.int32) + 1
+            extra_mono["leaf_step"] = setw(
+                setw(st["leaf_step"], leaf, jt), new_id, jt)
 
         return dict(
             **extra,
@@ -1072,10 +1087,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # intermediate monotone mode: the cached split may violate bounds
         # tightened since it was found — re-search against CURRENT bounds
         # (RecomputeBestSplitForLeaf analog), with the same feature gates
-        # the cached search had (interaction branch mask, CEGB penalties).
-        # A leaf whose re-search finds nothing is retired (gain -> NEG_INF)
-        # without consuming a node slot.
-        fmask_j = node_feature_mask(jj)
+        # the cached search had: per-node mask and extra-trees thresholds
+        # re-keyed by the step the cache was built at (leaf_step), the
+        # interaction branch mask, and CEGB penalties.  A leaf whose
+        # re-search finds nothing is retired (gain -> NEG_INF) without
+        # consuming a node slot.
+        step0 = st["leaf_step"][leaf]
+        fmask_j = node_feature_mask(step0)
         if interaction_sets is not None:
             fmask_j = fmask_j * interaction_allowed(st["leaf_branch"][leaf])
         pen_j = None
@@ -1091,7 +1109,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                      st["leaf_weight"][leaf], st["leaf_count"][leaf],
                      fmask_j, 0.0,
                      st["leaf_lo"][leaf], st["leaf_hi"][leaf],
-                     penalty=pen_j, rand=rand_thresholds(jj))
+                     penalty=pen_j, rand=rand_thresholds(step0))
         depth_ok = (cfg.max_depth <= 0) | (st["leaf_depth"][leaf]
                                            < cfg.max_depth)
         s_new = s_new._replace(gain=jnp.where(depth_ok, s_new.gain, NEG_INF))

@@ -25,13 +25,19 @@ import jax.numpy as jnp
 
 def build_histogram(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     mask: jax.Array, max_bin: int, *,
-                    method: str = "onehot", chunk_rows: int = 65536) -> jax.Array:
+                    method: str = "onehot", chunk_rows: int = 65536,
+                    f_limit: "int | None" = None) -> jax.Array:
     """Dispatch over histogram kernels; see module docstring.
 
     method: 'pallas' (fused VMEM one-hot, TPU), 'onehot' (XLA matmul),
-    'scatter' (XLA scatter-add, CPU tests)."""
+    'scatter' (XLA scatter-add, CPU tests).
+
+    f_limit: only the first ``f_limit`` columns carry real bins (the grower
+    packs gradient bytes into trailing columns); the pallas kernel skips the
+    rest at one-hot build time, the XLA fallbacks return them as garbage for
+    the caller to slice off."""
     if method == "pallas":
-        return _hist_pallas(bins, grad, hess, mask, max_bin)
+        return _hist_pallas(bins, grad, hess, mask, max_bin, f_limit=f_limit)
     return _build_histogram_xla(bins, grad, hess, mask, max_bin,
                                 method=method, chunk_rows=chunk_rows)
 
@@ -128,15 +134,19 @@ _PALLAS_BLOCK_ROWS = 1024
 # cannot bound the one-hot tile — _hist_pallas also shrinks BR to keep
 # FC*Bp*BR bf16 within _PALLAS_ONEHOT_BYTES of VMEM.
 _PALLAS_BLOCK_LANES = 2048
-_PALLAS_ONEHOT_BYTES = 4 * 1024 * 1024
+# v5e VMEM is ~128MB; 8MB keeps the tile comfortably resident alongside the
+# in/out blocks while letting BR (grid-step row count) stay large enough to
+# amortize per-step overheads
+_PALLAS_ONEHOT_BYTES = 8 * 1024 * 1024
 
 
 # cap so that the 128-row BR floor never busts _PALLAS_ONEHOT_BYTES:
-# f*Bp*128 bf16 <= 4MiB  =>  f*Bp <= 16384
-_PALLAS_ROWMAJOR_MAX_LANES = 16384
+# f*Bp*128 bf16 <= 8MiB  =>  f*Bp <= 32768
+_PALLAS_ROWMAJOR_MAX_LANES = 32768
 
 
-def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None):
+def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,
+                 f_limit=None):
     """Fused histogram: Pallas TPU kernel, bf16 split-precision one-hot matmul.
 
     TPUs have no fast scatter atomics, so the scatter-add is a one-hot matmul
@@ -172,7 +182,8 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None):
     """
     from jax.experimental import pallas as pl
 
-    n, f = bins.shape
+    n, f_cols = bins.shape
+    f = min(f_limit, f_cols) if f_limit is not None else f_cols
     B = max_bin
     Bp = -(-B // 128) * 128                      # lane-tile aligned bin width
 
@@ -201,12 +212,14 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None):
             def _init():
                 out_ref[:] = jnp.zeros_like(out_ref)
 
-            # transpose the small [BR, f] tile in VMEM so the one-hot can be
-            # built as [f, Bp, BR] and reshaped [f*Bp, BR] by merging LEADING
-            # dims (layout-free).  A [BR, f, Bp] -> [BR, f*Bp] reshape would
-            # merge a non-lane-aligned dim into lanes — a per-step relayout
-            # that benched ~10x slower.
-            b = bins_ref[:].astype(jnp.int32).T               # [f_pad, BR]
+            # transpose the small [BR, f_cols] tile in VMEM so the one-hot
+            # can be built as [f, Bp, BR] and reshaped [f*Bp, BR] by merging
+            # LEADING dims (layout-free).  A [BR, f, Bp] -> [BR, f*Bp]
+            # reshape would merge a non-lane-aligned dim into lanes — a
+            # per-step relayout that benched ~10x slower.  Trailing f_limit
+            # columns (packed gradient bytes) are dropped by the sublane
+            # slice after the transpose.
+            b = bins_ref[:].astype(jnp.int32).T[:f_pad]       # [f_pad, BR]
             bin_id = jax.lax.broadcasted_iota(jnp.int32, (f_pad, Bp, BR), 1)
             onehot = (b[:, None, :] == bin_id).astype(jnp.bfloat16)
             onehot = onehot.reshape(f_pad * Bp, BR)
@@ -219,12 +232,14 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None):
             kernel_rm,
             out_shape=jax.ShapeDtypeStruct((6, f_pad * Bp), jnp.float32),
             grid=(n_rb,),
-            in_specs=[pl.BlockSpec((BR, f_pad), lambda i: (i, 0)),
+            in_specs=[pl.BlockSpec((BR, bins.shape[1]), lambda i: (i, 0)),
                       pl.BlockSpec((6, BR), lambda i: (0, i))],
             out_specs=pl.BlockSpec((6, f_pad * Bp), lambda i: (0, 0)),
         )(bins, gh6)
     else:
         # ---- feature-major blocked path (wide features) --------------------
+        if f < f_cols:
+            bins = bins[:, :f]                   # drop packed-gradient cols
         FC = max(8, _PALLAS_BLOCK_LANES // Bp)   # features per block (8-mult)
         n_fb = -(-f // FC)
         f_pad = n_fb * FC

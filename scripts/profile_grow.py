@@ -37,7 +37,9 @@ import os
 cfg = GrowerConfig(num_leaves=leaves, max_depth=-1, max_bin=B, split=sp,
                    feature_fraction_bynode=1.0, hist_method="pallas",
                    hist_chunk_rows=chunk, hist_compact=compact,
-                   sorted_cat=bool(int(os.environ.get("PROF_SORTED_CAT", "0"))))
+                   sorted_cat=bool(int(os.environ.get("PROF_SORTED_CAT", "0"))),
+                   hist_compact_ladder=float(os.environ.get("PROF_LADDER",
+                                                            "1.41")))
 
 
 @jax.jit

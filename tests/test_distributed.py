@@ -1,0 +1,124 @@
+"""Multi-process distributed smoke test (SURVEY §4 implication: the
+reference exercises its socket collectives for real via a local Dask
+cluster, tests/python_package_test/test_dask.py:21-47).
+
+Here: two OS processes bring up ``jax.distributed`` over a localhost
+coordinator (``mesh.init_distributed`` — the analog of LGBM_NetworkInit +
+machine lists), build a global 2-device CPU mesh, and run one data-parallel
+training step with cross-process psum collectives.  Each process pins ONE
+virtual CPU device, so the mesh genuinely spans processes.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import os, sys
+import numpy as np
+
+proc_id = int(sys.argv[1])
+coord = sys.argv[2]
+
+sys.path.insert(0, "@REPO@")
+from lightgbm_tpu.parallel.mesh import init_distributed
+init_distributed(coordinator_address=coord, num_processes=2,
+                 process_id=proc_id)
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 2, jax.devices()
+
+from lightgbm_tpu.ops.grower import GrowerConfig, grow_tree
+from lightgbm_tpu.ops.split import SplitParams
+
+n, f, B, L = 512, 6, 16, 7
+rng = np.random.default_rng(0)
+bins_np = rng.integers(0, B, size=(n, f), dtype=np.uint8)
+g_np = rng.normal(size=n).astype(np.float32)
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+sp = SplitParams(0.0, 0.0, 5, 1e-3, 0.0, 0.0, 0.0, 10.0, 10.0, 4)
+cfg = GrowerConfig(num_leaves=L, max_depth=-1, max_bin=B, split=sp,
+                   feature_fraction_bynode=1.0, hist_method="onehot",
+                   hist_chunk_rows=65536, axis_name="dp",
+                   parallel_mode="data", num_shards=2, sorted_cat=False)
+meta = dict(num_bins=jnp.full(f, B, jnp.int32),
+            default_bins=jnp.zeros(f, jnp.int32),
+            nan_bins=jnp.full(f, -1, jnp.int32),
+            is_categorical=jnp.zeros(f, bool),
+            monotone=jnp.zeros(f, jnp.int32))
+
+
+def grow(bins, g, h, rw, fm, key):
+    return grow_tree(bins, g, h, rw, fm, **meta, key=key, cfg=cfg)
+
+
+sharded = jax.shard_map(
+    grow, mesh=mesh,
+    in_specs=(P("dp"), P("dp"), P("dp"), P("dp"), P(), P()),
+    out_specs=(P(), P("dp")), check_vma=False)
+
+# globally-sharded inputs: each process provides its local half
+def gshard(arr, spec):
+    sh = NamedSharding(mesh, spec)
+    return jax.make_array_from_process_local_data(sh, arr, arr.shape)
+
+half = n // 2
+lo, hi = (0, half) if proc_id == 0 else (half, n)
+bins_g = gshard(bins_np[lo:hi], P("dp"))
+g_g = gshard(g_np[lo:hi], P("dp"))
+h_g = gshard(np.full(half, 0.25, np.float32), P("dp"))
+rw_g = gshard(np.ones(half, np.float32), P("dp"))
+fm = jnp.ones(f, jnp.float32)
+
+tree, na = jax.jit(sharded)(bins_g, g_g, h_g, rw_g, fm,
+                            jax.random.PRNGKey(0))
+nl = int(tree.num_leaves)
+assert nl > 1, nl
+vals = np.asarray(tree.leaf_value)
+print("proc{} OK nl={} checksum={:.6f}".format(
+    proc_id, nl, float(np.abs(vals).sum())))
+"""
+
+
+def test_two_process_data_parallel_step(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.replace("@REPO@", REPO))
+
+    procs = []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO
+        # one device per process -> the 2-device mesh spans processes
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env.pop("_LGBM_TPU_DRYRUN_CHILD", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(pid), coord],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc{pid} failed:\n{out}"
+        assert f"proc{pid} OK" in out, out
+    # both processes computed the same (replicated) tree
+    chk = [line for out in outs for line in out.splitlines()
+           if "checksum=" in line]
+    assert len(chk) == 2
+    assert chk[0].split("checksum=")[1] == chk[1].split("checksum=")[1]

@@ -277,3 +277,520 @@ def test_monotone_method_fallback(regression_data):
     grid[:, 0] = np.linspace(X[:, 0].min(), X[:, 0].max(), 50)
     pred = bst.predict(grid)
     assert (np.diff(pred) >= -1e-10).all()
+
+
+# ===========================================================================
+# Round-3 matrix expansion, mirroring the reference test_engine.py areas:
+# missing-value modes (:120-271), categorical handling (:272-385), continued
+# training (:622-712), objective x metric sweep, cv edge cases, structural
+# parameter effects.
+# ===========================================================================
+
+def _mk_binary(n=1200, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = ((X[:, 0] + X[:, 1] * X[:, 2] + rng.logistic(size=n) * 0.3) > 0
+         ).astype(float)
+    return X, y
+
+
+def _mk_regression(n=1200, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 2 + np.abs(X[:, 1]) + rng.normal(0, 0.2, n)
+    return X, y
+
+
+# ---- missing value mode matrix --------------------------------------------
+
+def test_missing_nan_routes_learned_direction():
+    """NaN rows carry signal: the learned default direction must use it."""
+    rng = np.random.default_rng(3)
+    n = 2000
+    x = rng.normal(size=n)
+    miss = rng.uniform(size=n) < 0.3
+    y = np.where(miss, 1.0, (x > 0).astype(float))
+    X = np.column_stack([np.where(miss, np.nan, x), rng.normal(size=n)])
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y), 10)
+    p_nan = bst.predict(np.column_stack([[np.nan] * 5, np.zeros(5)]))
+    p_pos = bst.predict(np.column_stack([np.full(5, 2.0), np.zeros(5)]))
+    p_neg = bst.predict(np.column_stack([np.full(5, -2.0), np.zeros(5)]))
+    assert p_nan.mean() > 0.8          # NaN bucket learned to be class 1
+    assert p_pos.mean() > 0.8 and p_neg.mean() < 0.3
+
+
+def test_use_missing_false_treats_nan_as_value():
+    X, y = _mk_binary()
+    X = X.copy()
+    X[::7, 0] = np.nan
+    for um in (True, False):
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "use_missing": um, "verbosity": -1},
+                        lgb.Dataset(X, label=y), 10)
+        assert _auc(y, bst.predict(X)) > 0.8
+
+
+def test_zero_as_missing():
+    rng = np.random.default_rng(4)
+    n = 1500
+    x = rng.normal(size=n)
+    zero = rng.uniform(size=n) < 0.4
+    y = np.where(zero, 1.0, (x > 0).astype(float))
+    X = np.column_stack([np.where(zero, 0.0, x), rng.normal(size=n)])
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "zero_as_missing": True, "verbosity": -1},
+                    lgb.Dataset(X, label=y,
+                                params={"zero_as_missing": True}), 10)
+    p_zero = bst.predict(np.column_stack([np.zeros(5), np.zeros(5)]))
+    assert p_zero.mean() > 0.7
+
+
+def test_all_nan_feature_is_dropped():
+    X, y = _mk_binary(n=600)
+    X = X.copy()
+    X[:, 3] = np.nan
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y), 5)
+    assert bst.feature_importance()[3] == 0
+
+
+def test_predict_with_nan_unseen_at_train():
+    X, y = _mk_binary(n=800)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 10)
+    Xn = X[:50].copy()
+    Xn[:, 0] = np.nan
+    p = bst.predict(Xn)
+    assert np.isfinite(p).all()
+
+
+# ---- categorical matrix ----------------------------------------------------
+
+def test_categorical_many_categories_sorted_split():
+    rng = np.random.default_rng(5)
+    n, k = 4000, 60                    # > max_cat_to_onehot -> sorted scan
+    cat = rng.integers(0, k, n)
+    good = set(rng.choice(k, 20, replace=False).tolist())
+    y = (np.isin(cat, list(good)) ^ (rng.uniform(size=n) < 0.05)).astype(float)
+    X = np.column_stack([cat.astype(float), rng.normal(size=n)])
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]), 15)
+    assert _auc(y, bst.predict(X)) > 0.95
+
+
+def test_categorical_unseen_category_at_predict():
+    rng = np.random.default_rng(6)
+    n = 1000
+    cat = rng.integers(0, 8, n)
+    y = (cat >= 4).astype(float)
+    X = np.column_stack([cat.astype(float), rng.normal(size=n)])
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]), 10)
+    p = bst.predict(np.array([[99.0, 0.0], [-5.0, 0.0]]))
+    assert np.isfinite(p).all()
+
+
+def test_categorical_negative_codes_go_to_catchall():
+    rng = np.random.default_rng(7)
+    n = 1000
+    cat = rng.integers(0, 6, n).astype(float)
+    cat[::9] = -1                       # negative category codes
+    y = (cat >= 3).astype(float)
+    X = np.column_stack([cat, rng.normal(size=n)])
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]), 10)
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_max_cat_to_onehot_boundary():
+    rng = np.random.default_rng(8)
+    n, k = 1500, 6
+    cat = rng.integers(0, k, n)
+    y = np.isin(cat, [1, 4]).astype(float)
+    X = np.column_stack([cat.astype(float)])
+    for moh in (2, 32):                 # sorted scan vs pure one-hot
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "max_cat_to_onehot": moh, "verbosity": -1,
+                         "min_data_in_leaf": 5},
+                        lgb.Dataset(X, label=y, categorical_feature=[0]), 10)
+        assert _auc(y, bst.predict(X)) > 0.95, moh
+
+
+def test_categorical_and_numerical_mix_with_nan():
+    rng = np.random.default_rng(9)
+    n = 1500
+    cat = rng.integers(0, 12, n).astype(float)
+    num = rng.normal(size=n)
+    num[::5] = np.nan
+    y = ((cat >= 6) & (np.nan_to_num(num) > -0.5)).astype(float)
+    X = np.column_stack([cat, num])
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]), 15)
+    assert _auc(y, bst.predict(X)) > 0.9
+
+
+# ---- continued training ----------------------------------------------------
+
+def test_continued_training_improves(binary_data, tmp_path):
+    Xtr, ytr, Xte, yte = binary_data
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    bst1 = lgb.train(p, lgb.Dataset(Xtr, label=ytr), 5)
+    auc1 = _auc(yte, bst1.predict(Xte))
+    f = str(tmp_path / "m.txt")
+    bst1.save_model(f)
+    bst2 = lgb.train(p, lgb.Dataset(Xtr, label=ytr), 15, init_model=f)
+    assert bst2.num_trees() == 20
+    assert _auc(yte, bst2.predict(Xte)) >= auc1 - 1e-9
+
+
+def test_continued_training_from_booster_object(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    bst1 = lgb.train(p, lgb.Dataset(Xtr, label=ytr), 5)
+    bst2 = lgb.train(p, lgb.Dataset(Xtr, label=ytr), 5, init_model=bst1)
+    assert bst2.num_trees() == 10
+
+
+def test_continued_training_matches_single_run(binary_data):
+    """5+5 continued rounds == 10 straight rounds (same data, no reseeding
+    side effects) — the reference asserts logloss evolution continuity."""
+    Xtr, ytr, Xte, yte = binary_data
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "learning_rate": 0.1}
+    whole = lgb.train(p, lgb.Dataset(Xtr, label=ytr), 10)
+    part1 = lgb.train(p, lgb.Dataset(Xtr, label=ytr), 5)
+    part2 = lgb.train(p, lgb.Dataset(Xtr, label=ytr), 5, init_model=part1)
+    np.testing.assert_allclose(part2.predict(Xte), whole.predict(Xte),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_init_score_dataset(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    init = np.full(len(ytr), 1.2345)
+    ds = lgb.Dataset(Xtr, label=ytr, init_score=init)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, ds, 3)
+    raw = bst.predict(Xtr, raw_score=True)
+    # trained deltas ride on the init score, which predict() does NOT add
+    assert np.isfinite(raw).all()
+
+
+# ---- objective x metric sweep ---------------------------------------------
+
+@pytest.mark.parametrize("objective,metric", [
+    ("huber", "huber"), ("fair", "fair"), ("quantile", "quantile"),
+    ("mape", "mape"),
+])
+def test_robust_regression_objectives(objective, metric):
+    X, y = _mk_regression()
+    res = {}
+    ds = lgb.Dataset(X, label=y)
+    lgb.train({"objective": objective, "metric": metric, "num_leaves": 15,
+               "verbosity": -1}, ds, 15,
+              valid_sets=[ds.create_valid(X, label=y)], evals_result=res,
+              verbose_eval=False)
+    vals = list(res["valid_0"].values())[0]
+    assert vals[-1] < vals[0]          # training reduces the loss
+
+
+@pytest.mark.parametrize("objective", ["poisson", "gamma", "tweedie"])
+def test_positive_regression_objectives(objective):
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(1200, 6))
+    mu = np.exp(0.5 * X[:, 0] + 0.3 * X[:, 1])
+    y = rng.poisson(mu).astype(float) + (0.0 if objective == "poisson" else 0.1)
+    bst = lgb.train({"objective": objective, "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 20)
+    p = bst.predict(X)
+    assert (p > 0).all()               # ConvertOutput exponentiates
+    assert np.corrcoef(p, y)[0, 1] > 0.5
+
+
+def test_xentropy_objectives():
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(1200, 6))
+    prob = 1 / (1 + np.exp(-(X[:, 0] + 0.5 * X[:, 1])))
+    y = prob * 0.9 + 0.05              # soft labels in (0, 1)
+    for obj in ("cross_entropy", "cross_entropy_lambda"):
+        bst = lgb.train({"objective": obj, "num_leaves": 15,
+                         "verbosity": -1}, lgb.Dataset(X, label=y), 15)
+        p = bst.predict(X)
+        if obj == "cross_entropy":
+            assert ((p >= 0) & (p <= 1)).all()   # probability output
+        else:
+            # xentlambda converts to the POISSON INTENSITY lambda > 0,
+            # not a probability (xentropy_objective.hpp:233-235)
+            assert (p > 0).all()
+        assert np.corrcoef(p, prob)[0, 1] > 0.9
+
+
+def test_multiclassova(multiclass_data):
+    Xtr, ytr, Xte, yte = multiclass_data
+    bst = lgb.train({"objective": "multiclassova", "num_class": 4,
+                     "num_leaves": 15, "verbosity": -1},
+                    lgb.Dataset(Xtr, label=ytr), 20)
+    pred = bst.predict(Xte)
+    assert pred.shape == (len(yte), 4)
+    acc = float(np.mean(np.argmax(pred, axis=1) == yte))
+    assert acc > 0.75
+
+
+def test_binary_is_unbalance_and_scale_pos_weight():
+    rng = np.random.default_rng(13)
+    n = 3000
+    X = rng.normal(size=(n, 6))
+    y = ((X[:, 0] + rng.logistic(size=n)) > 2.2).astype(float)  # ~10% pos
+    base = lgb.train({"objective": "binary", "num_leaves": 15,
+                      "verbosity": -1}, lgb.Dataset(X, label=y), 10)
+    unb = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "is_unbalance": True, "verbosity": -1},
+                    lgb.Dataset(X, label=y), 10)
+    spw = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "scale_pos_weight": 9.0, "verbosity": -1},
+                    lgb.Dataset(X, label=y), 10)
+    # reweighting must raise the positive-class scores
+    assert unb.predict(X).mean() > base.predict(X).mean()
+    assert spw.predict(X).mean() > base.predict(X).mean()
+
+
+def test_boost_from_average_off():
+    X, y = _mk_regression()
+    y = y + 100.0                       # large offset
+    on = lgb.train({"objective": "regression", "num_leaves": 7,
+                    "verbosity": -1}, lgb.Dataset(X, label=y), 3)
+    off = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "boost_from_average": False, "verbosity": -1},
+                    lgb.Dataset(X, label=y), 3)
+    # with the average start the 3-round model is already centered
+    assert abs(on.predict(X).mean() - y.mean()) < 1.0
+    assert off.predict(X).mean() < y.mean() - 1.0
+
+
+def test_lambdarank_ndcg_improves():
+    rng = np.random.default_rng(14)
+    n_q, per_q = 80, 12
+    n = n_q * per_q
+    X = rng.normal(size=(n, 8))
+    rel = X[:, 0] + 0.5 * X[:, 1] + rng.normal(0, 0.5, n)
+    y = np.clip(np.digitize(rel, [-0.5, 0.5, 1.5]), 0, 3).astype(float)
+    group = np.full(n_q, per_q)
+    res = {}
+    ds = lgb.Dataset(X, label=y, group=group)
+    lgb.train({"objective": "lambdarank", "metric": "ndcg",
+               "ndcg_eval_at": [5], "num_leaves": 15, "verbosity": -1},
+              ds, 20, valid_sets=[lgb.Dataset(X, label=y, group=group,
+                                              reference=ds)],
+              evals_result=res, verbose_eval=False)
+    vals = res["valid_0"]["ndcg@5"]
+    assert vals[-1] > vals[0]
+
+
+def test_rank_xendcg_trains():
+    rng = np.random.default_rng(15)
+    n_q, per_q = 60, 10
+    n = n_q * per_q
+    X = rng.normal(size=(n, 6))
+    y = np.clip((X[:, 0] > 0).astype(float) + (X[:, 1] > 1), 0, 3)
+    ds = lgb.Dataset(X, label=y, group=np.full(n_q, per_q))
+    bst = lgb.train({"objective": "rank_xendcg", "num_leaves": 15,
+                     "verbosity": -1}, ds, 10)
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_multiple_metrics_recorded(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    res = {}
+    tr = lgb.Dataset(Xtr, label=ytr)
+    lgb.train({"objective": "binary", "metric": ["auc", "binary_logloss",
+                                                 "binary_error"],
+               "num_leaves": 15, "verbosity": -1}, tr, 8,
+              valid_sets=[tr.create_valid(Xte, label=yte)],
+              evals_result=res, verbose_eval=False)
+    assert set(res["valid_0"]) == {"auc", "binary_logloss", "binary_error"}
+    assert all(len(v) == 8 for v in res["valid_0"].values())
+
+
+# ---- cv edge cases ---------------------------------------------------------
+
+def test_cv_shapes_and_monotone_mean(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    out = lgb.cv({"objective": "binary", "metric": "binary_logloss",
+                  "num_leaves": 7, "verbosity": -1},
+                 lgb.Dataset(Xtr, label=ytr), num_boost_round=10, nfold=3,
+                 stratified=True, seed=7)
+    key = [k for k in out if "mean" in k][0]
+    assert len(out[key]) == 10
+    assert out[key][-1] < out[key][0]
+
+
+def test_cv_unstratified_and_shuffle(regression_data):
+    Xtr, ytr, _, _ = regression_data
+    out = lgb.cv({"objective": "regression", "metric": "l2",
+                  "num_leaves": 7, "verbosity": -1},
+                 lgb.Dataset(Xtr, label=ytr), num_boost_round=8, nfold=4,
+                 stratified=False, shuffle=True, seed=1)
+    key = [k for k in out if "mean" in k][0]
+    assert len(out[key]) == 8
+
+
+def test_cv_early_stopping(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    out = lgb.cv({"objective": "binary", "metric": "binary_logloss",
+                  "num_leaves": 63, "learning_rate": 0.5, "verbosity": -1},
+                 lgb.Dataset(Xtr, label=ytr), num_boost_round=100, nfold=3,
+                 early_stopping_rounds=5, seed=3)
+    key = [k for k in out if "mean" in k][0]
+    assert len(out[key]) < 100
+
+
+def test_cv_return_cvbooster(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    out = lgb.cv({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                 lgb.Dataset(Xtr, label=ytr), num_boost_round=5, nfold=3,
+                 return_cvbooster=True)
+    cvb = out["cvbooster"]
+    preds = cvb.predict(Xtr[:20])
+    assert len(preds) == 3
+    assert all(p.shape == (20,) for p in preds)
+
+
+# ---- structural parameter effects -----------------------------------------
+
+def test_min_gain_to_split_prunes(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    loose = lgb.train({"objective": "binary", "num_leaves": 63,
+                       "verbosity": -1}, lgb.Dataset(Xtr, label=ytr), 3)
+    tight = lgb.train({"objective": "binary", "num_leaves": 63,
+                       "min_gain_to_split": 10.0, "verbosity": -1},
+                      lgb.Dataset(Xtr, label=ytr), 3)
+    n_loose = sum(t.num_leaves for t in loose._gbdt.models)
+    n_tight = sum(t.num_leaves for t in tight._gbdt.models)
+    assert n_tight < n_loose
+
+
+def test_min_data_in_leaf_respected(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    bst = lgb.train({"objective": "binary", "num_leaves": 63,
+                     "min_data_in_leaf": 100, "verbosity": -1},
+                    lgb.Dataset(Xtr, label=ytr), 3)
+    for t in bst._gbdt.models:
+        counts = t.leaf_count[:t.num_leaves]
+        assert (counts[counts > 0] >= 100).all()
+
+
+def test_max_delta_step_caps_outputs():
+    X, y = _mk_regression()
+    y = y * 100
+    # boost_from_average off: the first tree would otherwise carry the mean
+    # as a bias on top of the clamped deltas
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "max_delta_step": 0.01, "learning_rate": 1.0,
+                     "boost_from_average": False,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 2)
+    for t in bst._gbdt.models:
+        assert np.abs(t.leaf_value[:t.num_leaves]).max() <= 0.01 + 1e-9
+
+
+def test_path_smooth_changes_model(regression_data):
+    Xtr, ytr, _, _ = regression_data
+    a = lgb.train({"objective": "regression", "num_leaves": 31,
+                   "verbosity": -1}, lgb.Dataset(Xtr, label=ytr), 5)
+    b = lgb.train({"objective": "regression", "num_leaves": 31,
+                   "path_smooth": 10.0, "min_data_in_leaf": 5,
+                   "verbosity": -1}, lgb.Dataset(Xtr, label=ytr), 5)
+    assert not np.allclose(a.predict(Xtr[:50]), b.predict(Xtr[:50]))
+
+
+def test_lambda_l1_l2_regularize(regression_data):
+    Xtr, ytr, _, _ = regression_data
+    base = lgb.train({"objective": "regression", "num_leaves": 31,
+                      "verbosity": -1}, lgb.Dataset(Xtr, label=ytr), 5)
+    reg = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "lambda_l1": 5.0, "lambda_l2": 50.0, "verbosity": -1},
+                    lgb.Dataset(Xtr, label=ytr), 5)
+    mag = lambda m: float(np.mean([np.abs(t.leaf_value[:t.num_leaves]).mean()
+                                   for t in m._gbdt.models]))
+    assert mag(reg) < mag(base)
+
+
+def test_feature_fraction_bynode_trains(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "feature_fraction_bynode": 0.5, "verbosity": -1},
+                    lgb.Dataset(Xtr, label=ytr), 15)
+    assert _auc(yte, bst.predict(Xte)) > 0.9
+
+
+def test_forced_splits_engine(tmp_path, binary_data):
+    import json
+    Xtr, ytr, _, _ = binary_data
+    fs = tmp_path / "forced.json"
+    fs.write_text(json.dumps({"feature": 0, "threshold": 0.0}))
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "forcedsplits_filename": str(fs), "verbosity": -1},
+                    lgb.Dataset(Xtr, label=ytr), 3)
+    for t in bst._gbdt.models:
+        assert t.split_feature[0] == 0      # root forced onto feature 0
+
+
+def test_num_leaves_2_stumps(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    bst = lgb.train({"objective": "binary", "num_leaves": 2,
+                     "verbosity": -1}, lgb.Dataset(Xtr, label=ytr), 20)
+    assert all(t.num_leaves <= 2 for t in bst._gbdt.models)
+    assert _auc(yte, bst.predict(Xte)) > 0.7
+
+
+def test_constant_feature_single_leaf():
+    X = np.ones((200, 3))
+    y = np.random.default_rng(0).uniform(size=200)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y), 3)
+    p = bst.predict(X[:5])
+    np.testing.assert_allclose(p, y.mean(), rtol=1e-5)
+
+
+def test_predict_feature_count_mismatch_raises(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(Xtr, label=ytr), 2)
+    with pytest.raises(Exception):
+        bst.predict(Xtr[:, :-1])
+
+
+def test_deterministic_same_seed(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    p = {"objective": "binary", "num_leaves": 15, "bagging_fraction": 0.7,
+         "bagging_freq": 1, "feature_fraction": 0.8, "seed": 42,
+         "verbosity": -1}
+    a = lgb.train(p, lgb.Dataset(Xtr, label=ytr), 5)
+    b = lgb.train(p, lgb.Dataset(Xtr, label=ytr), 5)
+    np.testing.assert_array_equal(a.predict(Xtr[:100]), b.predict(Xtr[:100]))
+
+
+def test_first_metric_only_early_stop(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    tr = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train({"objective": "binary", "metric": ["auc",
+                                                       "binary_logloss"],
+                     "num_leaves": 63, "learning_rate": 0.5,
+                     "first_metric_only": True, "verbosity": -1},
+                    tr, 100, valid_sets=[tr.create_valid(Xte, label=yte)],
+                    early_stopping_rounds=5, verbose_eval=False)
+    assert bst.best_iteration > 0
+
+
+def test_snapshot_plus_continue(tmp_path, binary_data):
+    """snapshot -> load -> continue: checkpoint-restart end to end."""
+    Xtr, ytr, Xte, yte = binary_data
+    out = str(tmp_path / "m.txt")
+    lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+               "snapshot_freq": 2, "output_model": out},
+              lgb.Dataset(Xtr, label=ytr), 4)
+    snap = out + ".snapshot_iter_2"
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(Xtr, label=ytr), 3,
+                    init_model=snap)
+    assert bst.num_trees() == 5
