@@ -86,8 +86,10 @@ class Dataset:
             # resolve categorical feature names -> indices
             if cats is not None and feature_names is not None:
                 cats = [feature_names.index(c) if isinstance(c, str) else c for c in cats]
+            from .io.dataset import _is_sparse
             self._inner = _InnerDataset.from_data(
-                np.asarray(data, dtype=np.float64) if not hasattr(data, "values") else data,
+                data if (hasattr(data, "values") or _is_sparse(data))
+                else np.asarray(data, dtype=np.float64),
                 cfg, label=self.label, weight=self.weight, group=self.group,
                 init_score=self.init_score, categorical_feature=cats,
                 feature_names=feature_names, reference=ref_inner)
@@ -317,7 +319,11 @@ class Booster:
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
         if hasattr(data, "values"):
             data = data.values
-        data = np.asarray(data, dtype=np.float64)
+        from .io.dataset import _is_sparse
+        if _is_sparse(data):   # scipy.sparse: block-densified predict
+            data = data.tocsr()
+        else:
+            data = np.asarray(data, dtype=np.float64)
         n_feat = self.num_feature()
         data_feat = data.shape[1] if data.ndim == 2 else data.shape[0]
         if data_feat != n_feat and not kwargs.get("predict_disable_shape_check", False):

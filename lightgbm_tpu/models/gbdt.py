@@ -30,6 +30,25 @@ from ..utils.random_gen import key_for_iteration
 from ..utils.timer import global_timer
 from .tree import Tree
 
+# rows per densified block when predicting on scipy.sparse input: bounds
+# peak host memory at block_rows * F floats (reference predicts CSR rows
+# one at a time; here a block feeds the device ensemble predictor)
+_SPARSE_PREDICT_BLOCK = 65536
+
+
+from ..io.dataset import _is_sparse as _is_sparse_mat
+
+
+def _blockwise_sparse(X, fn):
+    """Apply ``fn`` (a dense-matrix predict) over densified row blocks of a
+    scipy.sparse matrix and concatenate the results."""
+    X = X.tocsr()
+    if X.shape[0] == 0:
+        return fn(np.zeros((0, X.shape[1]), np.float64))
+    outs = [fn(np.asarray(X[s:s + _SPARSE_PREDICT_BLOCK].toarray(), np.float64))
+            for s in range(0, X.shape[0], _SPARSE_PREDICT_BLOCK)]
+    return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+
 
 class GBDT:
     """Gradient Boosting Decision Tree engine (reference ``gbdt.h:35``)."""
@@ -797,6 +816,9 @@ class GBDT:
         ensemble (``ops/ensemble.py``) instead of a per-tree host loop —
         the TPU analog of the reference's OpenMP block predictor
         (``gbdt_prediction.cpp:20-72``)."""
+        if _is_sparse_mat(X):
+            return _blockwise_sparse(
+                X, lambda d: self.predict_raw(d, num_iteration, start_iteration))
         X = np.asarray(X, dtype=np.float64)
         if X.ndim == 1:
             X = X.reshape(1, -1)
@@ -888,6 +910,10 @@ class GBDT:
         if any(getattr(t, "is_linear", False) for t in self.models):
             raise LightGBMError(
                 "pred_contrib (TreeSHAP) is not supported for linear trees")
+        if _is_sparse_mat(X):
+            return _blockwise_sparse(
+                X, lambda d: self.predict_contrib(d, num_iteration,
+                                                  start_iteration))
         X = np.asarray(X, dtype=np.float64)
         if X.ndim == 1:
             X = X.reshape(1, -1)
@@ -907,6 +933,9 @@ class GBDT:
         return out[:, 0, :] if K == 1 else out.reshape(n, K * (F + 1))
 
     def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        if _is_sparse_mat(X):
+            return _blockwise_sparse(
+                X, lambda d: self.predict_leaf_index(d, num_iteration))
         X = np.asarray(X, dtype=np.float64)
         if X.ndim == 1:
             X = X.reshape(1, -1)

@@ -161,7 +161,7 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,
     Two layouts, chosen by total lane width (Mosaic requires a block's last
     dim to be a 128-multiple or the full array dim):
 
-    - **row-major single feature block** (``f*Bp <= 16k`` lanes): the bins
+    - **row-major single feature block** (``f*Bp <= 32k`` lanes): the bins
       block is ``(BR, f)`` — legal because ``f`` is the full array width —
       so bins ride straight from the dataset layout with NO transpose.  (A
       per-call ``[cap, F] -> [F, cap]`` u8 transpose benched at a fixed

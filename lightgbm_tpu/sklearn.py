@@ -12,6 +12,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from .io.dataset import _is_sparse
 from .basic import Booster, Dataset
 from .engine import train
 from .utils.log import LightGBMError
@@ -154,7 +155,8 @@ class LGBMModel:
             eval_group=None, eval_metric=None, early_stopping_rounds=None,
             feature_name="auto", categorical_feature="auto", callbacks=None,
             verbose: Any = False):
-        X = np.asarray(X, dtype=np.float64)
+        if not _is_sparse(X):
+            X = np.asarray(X, dtype=np.float64)
         y = np.asarray(y).ravel()
         self._n_features = X.shape[1]
         params = self._lgb_params()
@@ -177,9 +179,13 @@ class LGBMModel:
         valid_sets, valid_names = [], []
         if eval_set is not None:
             for i, (vX, vy) in enumerate(eval_set):
-                vX = np.asarray(vX, dtype=np.float64)
+                if not _is_sparse(vX):
+                    vX = np.asarray(vX, dtype=np.float64)
                 vy = np.asarray(vy).ravel()
-                if vX is X or (vX.shape == X.shape and np.array_equal(vX, X)):
+                if vX is X or (not _is_sparse(vX)
+                               and not _is_sparse(X)
+                               and vX.shape == X.shape
+                               and np.array_equal(vX, X)):
                     valid_sets.append(train_set)
                 else:
                     vw = eval_sample_weight[i] if eval_sample_weight else None
@@ -208,7 +214,8 @@ class LGBMModel:
                 num_iteration: Optional[int] = None, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs):
         self._check_fitted()
-        X = np.asarray(X, dtype=np.float64)
+        if not _is_sparse(X):
+            X = np.asarray(X, dtype=np.float64)
         if X.shape[1] != self._n_features:
             raise LightGBMError(
                 f"Number of features of the model must match the input. Model "

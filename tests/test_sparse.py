@@ -1,0 +1,150 @@
+"""Sparse (scipy CSR/CSC) ingest and predict.
+
+Reference parity target: ``LGBM_DatasetCreateFromCSR`` / CSR predict paths
+(``src/c_api.cpp``) and the sparse-bin containers (``src/io/sparse_bin.hpp``).
+Our design streams sparse rows through block binning + EFB packing
+(``io/dataset.py:_bin_data_sparse``) so the device matrix stays dense and
+narrow; these tests pin dense<->sparse parity end to end.
+"""
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.dataset import Dataset as InnerDataset
+from lightgbm_tpu.config import Config
+
+
+def _sparse_data(n=2000, f=40, density=0.08, seed=7):
+    rng = np.random.default_rng(seed)
+    X = sps.random(n, f, density=density, format="csr", random_state=rng,
+                   data_rvs=lambda k: rng.normal(1.0, 1.0, k))
+    dense = np.asarray(X.toarray(), np.float64)
+    logit = dense[:, :5].sum(axis=1) - 0.5 * dense[:, 5:8].sum(axis=1)
+    y = (logit + rng.logistic(size=n) * 0.3 > 0).astype(np.float32)
+    return X, dense, y
+
+
+def test_inner_dataset_sparse_matches_dense():
+    X, dense, _ = _sparse_data()
+    cfg = Config.from_params({"max_bin": 63, "min_data_in_bin": 1})
+    ds_d = InnerDataset.from_data(dense, cfg)
+    ds_s = InnerDataset.from_data(X, cfg)
+    assert ds_s.num_data == ds_d.num_data
+    assert ds_s.used_features == ds_d.used_features
+    np.testing.assert_array_equal(np.asarray(ds_s.bins), np.asarray(ds_d.bins))
+    assert (ds_s.bundles is None) == (ds_d.bundles is None)
+    if ds_s.bundles is not None:
+        assert ds_s.bundles == ds_d.bundles
+
+
+def test_sparse_csc_and_coo_accepted():
+    X, dense, _ = _sparse_data(n=500, f=12)
+    cfg = Config.from_params({"max_bin": 31, "min_data_in_bin": 1})
+    ref = InnerDataset.from_data(dense, cfg)
+    for conv in (X.tocsc(), X.tocoo()):
+        ds = InnerDataset.from_data(conv, cfg)
+        np.testing.assert_array_equal(np.asarray(ds.bins), np.asarray(ref.bins))
+
+
+def test_sparse_train_predict_parity():
+    X, dense, y = _sparse_data()
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "verbose": -1, "seed": 3}
+    b_d = lgb.train(params, lgb.Dataset(dense, label=y), num_boost_round=8)
+    b_s = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+    pd = b_d.predict(dense)
+    ps = b_s.predict(X)
+    np.testing.assert_allclose(ps, pd, rtol=1e-6, atol=1e-7)
+    # sparse predict on a dense-trained model too (block-densified path)
+    np.testing.assert_allclose(b_d.predict(X), pd, rtol=1e-6, atol=1e-7)
+
+
+def test_sparse_validation_set_alignment():
+    X, dense, y = _sparse_data(n=1200, f=30)
+    tr, va = slice(0, 900), slice(900, 1200)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "metric": "binary_logloss"}
+    hist_d, hist_s = {}, {}
+    dtrain = lgb.Dataset(dense[tr], label=y[tr])
+    lgb.train(params, dtrain, num_boost_round=5,
+              valid_sets=[lgb.Dataset(dense[va], label=y[va], reference=dtrain)],
+              valid_names=["v"], evals_result=hist_d,
+              callbacks=[lgb.record_evaluation(hist_d)])
+    strain = lgb.Dataset(X[tr], label=y[tr])
+    lgb.train(params, strain, num_boost_round=5,
+              valid_sets=[lgb.Dataset(X[va], label=y[va], reference=strain)],
+              valid_names=["v"], evals_result=hist_s,
+              callbacks=[lgb.record_evaluation(hist_s)])
+    np.testing.assert_allclose(hist_s["v"]["binary_logloss"],
+                               hist_d["v"]["binary_logloss"], rtol=1e-6)
+
+
+def test_sparse_block_streaming_is_blockwise():
+    """Force multiple blocks through the streaming binner."""
+    X, dense, y = _sparse_data(n=3000, f=10)
+    cfg = Config.from_params({"max_bin": 15, "min_data_in_bin": 1})
+    old = InnerDataset._SPARSE_BLOCK_ROWS
+    InnerDataset._SPARSE_BLOCK_ROWS = 257          # ragged block edge
+    try:
+        ds_s = InnerDataset.from_data(X, cfg)
+    finally:
+        InnerDataset._SPARSE_BLOCK_ROWS = old
+    ds_d = InnerDataset.from_data(dense, cfg)
+    np.testing.assert_array_equal(np.asarray(ds_s.bins), np.asarray(ds_d.bins))
+
+
+def test_sparse_pred_leaf_and_contrib():
+    X, dense, y = _sparse_data(n=800, f=16)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4)
+    leaf_s = b.predict(X, pred_leaf=True)
+    leaf_d = b.predict(dense, pred_leaf=True)
+    np.testing.assert_array_equal(leaf_s, leaf_d)
+    c_s = b.predict(X, pred_contrib=True)
+    c_d = b.predict(dense, pred_contrib=True)
+    np.testing.assert_allclose(c_s, c_d, rtol=1e-6, atol=1e-8)
+    # contributions + bias sum to the raw score
+    np.testing.assert_allclose(c_s.sum(axis=1), b.predict(X, raw_score=True),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_sklearn_roundtrip():
+    X, dense, y = _sparse_data(n=600, f=20)
+    clf = lgb.LGBMClassifier(n_estimators=5, num_leaves=7, verbose=-1)
+    clf.fit(X, y)
+    proba = clf.predict_proba(X)
+    assert proba.shape == (600, 2)
+    clf_d = lgb.LGBMClassifier(n_estimators=5, num_leaves=7, verbose=-1)
+    clf_d.fit(dense, y)
+    np.testing.assert_allclose(proba, clf_d.predict_proba(dense),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_sparse_linear_tree_rejected():
+    X, _, y = _sparse_data(n=300, f=8)
+    with pytest.raises(Exception, match="linear_tree"):
+        lgb.train({"objective": "regression", "linear_tree": True,
+                   "verbose": -1}, lgb.Dataset(X, label=y), num_boost_round=2)
+
+
+def test_wide_sparse_efb_width_collapse():
+    """Allstate-shaped check scaled down: one-hot-ish wide sparse input must
+    bundle to far fewer device columns than raw features (VERDICT r2 #2)."""
+    rng = np.random.default_rng(0)
+    n, groups, per = 4000, 40, 10          # 400 raw features, one-hot by group
+    cols = np.concatenate([g * per + rng.integers(0, per, n)
+                           for g in range(groups)])
+    rows = np.tile(np.arange(n), groups)
+    vals = np.ones(n * groups)
+    X = sps.csr_matrix((vals, (rows, cols)), shape=(n, groups * per))
+    y = (np.asarray(X[:, ::per].sum(axis=1)).ravel() > 2).astype(np.float32)
+    cfg = Config.from_params({"max_bin": 255, "min_data_in_bin": 1})
+    ds = InnerDataset.from_data(X, cfg)
+    assert ds.bundles is not None
+    assert ds.bins.shape[1] <= groups * 2   # ~10x narrower than 400
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                   "min_data_in_bin": 1},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    auc_in = float(np.mean((b.predict(X) > 0.5) == y))
+    assert auc_in > 0.6
