@@ -190,24 +190,31 @@ class Dataset:
         return self
 
     # ------------------------------------------------------------------
-    def _construct_bin_mappers(self, data: np.ndarray, cats: set) -> None:
+    def _construct_bin_mappers(self, data, cats: set,
+                               presampled: Optional[np.ndarray] = None
+                               ) -> None:
         cfg = self.config
         n = self.num_data
         # row sampling for bin construction (reference bin_construct_sample_cnt,
         # dataset_loader.cpp SampleTextDataFromFile:902)
-        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
-        rng = Random(cfg.data_random_seed)
-        sample_idx = rng.sample(n, sample_cnt)
-        if _is_sparse(data):
-            # column-at-a-time densification: O(sample_cnt) per feature, never
-            # the full [sample, F] dense sample (which for Allstate-shaped
-            # data would itself exceed the binned matrix)
-            sample_csc = data[sample_idx].tocsc()
-            col = lambda f: np.asarray(  # noqa: E731
-                sample_csc[:, [f]].toarray(), np.float64).ravel()
+        if presampled is not None:
+            # distributed ingest: the pooled cross-process sample is given
+            sample_cnt = presampled.shape[0]
+            col = lambda f: presampled[:, f]  # noqa: E731
         else:
-            sample = data[sample_idx]
-            col = lambda f: sample[:, f]  # noqa: E731
+            sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+            rng = Random(cfg.data_random_seed)
+            sample_idx = rng.sample(n, sample_cnt)
+            if _is_sparse(data):
+                # column-at-a-time densification: O(sample_cnt) per feature,
+                # never the full [sample, F] dense sample (which for
+                # Allstate-shaped data would itself exceed the binned matrix)
+                sample_csc = data[sample_idx].tocsc()
+                col = lambda f: np.asarray(  # noqa: E731
+                    sample_csc[:, [f]].toarray(), np.float64).ravel()
+            else:
+                sample = data[sample_idx]
+                col = lambda f: sample[:, f]  # noqa: E731
 
         max_bin_by_feat = cfg.max_bin_by_feature
         self.bin_mappers = []
@@ -283,79 +290,41 @@ class Dataset:
         dtype = np.uint8 if width_max <= 256 else np.uint16
         out = np.empty((n, n_cols), dtype=dtype)
 
-        from ..native import bin_values
         blk = self._sparse_block_rows(self.num_total_features)
         for s in range(0, n, blk):
-            dense = np.asarray(data[s:s + blk].toarray(), np.float64)
-            native = bin_values(dense, self.bin_mappers, feats)
-            if native is not None:
-                bb = native.astype(np.uint16, copy=False)
-            else:
-                bb = np.empty((dense.shape[0], len(feats)), dtype=np.uint16)
-                for i, f in enumerate(feats):
-                    bb[:, i] = self.bin_mappers[f].value_to_bin(dense[:, f])
+            bb = self._bin_dense_block(
+                np.asarray(data[s:s + blk].toarray(), np.float64))
             if self.bundles is not None:
                 bb = build_bundle_matrix(bb, self.bundles, self.feat_off,
                                          self.bundle_widths)
             out[s:s + blk] = bb.astype(dtype, copy=False)
         self.bins = out
 
-    def _plan_bundles_from_sample(self, data) -> None:
-        """EFB layout discovery from a binned row sample (sparse path —
-        reference ``FindGroups`` runs on sampled indices the same way,
-        ``src/io/dataset.cpp:60-180``)."""
-        cfg = self.config
-        if (not cfg.enable_bundle or self.num_features <= 1
-                or cfg.tree_learner in ("feature", "voting")):
-            return
-        from .efb import MAX_BUNDLE_BINS, bundle_layout, find_bundles
-        feats = self.used_features
-        nb = np.array([self.bin_mappers[f].num_bin for f in feats], np.int64)
-        can = np.array([
-            self.bin_mappers[f].bin_type == BinType.NUMERICAL
-            and self.bin_mappers[f].default_bin == 0
-            and self.bin_mappers[f].num_bin <= MAX_BUNDLE_BINS
-            for f in feats])
-        if int(can.sum()) < 2:
-            return
-        n = self.num_data
-        # conflict counting converges quickly — cap the planning sample so the
-        # binned sample matrix stays small even at Allstate width (the dense
-        # path uses the full bin_construct sample because its binned matrix
-        # already exists; here it would have to be materialized)
-        s = min(n, max(1, cfg.bin_construct_sample_cnt), 50_000)
-        sample_idx = Random(cfg.data_random_seed + 1).sample(n, s)
-        sub = data[sample_idx]
+    def _bin_dense_block(self, dense: np.ndarray) -> np.ndarray:
+        """Bin one dense ``[rows, num_total_features]`` float block to a
+        ``[rows, num_used]`` uint16 matrix (native threaded binner with
+        numpy fallback) — shared by the sparse streaming path, EFB sample
+        planning and distributed ingest."""
         from ..native import bin_values
-        sb = np.empty((s, len(feats)), dtype=np.uint16)
-        blk = self._sparse_block_rows(self.num_total_features)
-        for bs in range(0, s, blk):
-            dense = np.asarray(sub[bs:bs + blk].toarray(), np.float64)
-            native = bin_values(dense, self.bin_mappers, feats)
-            if native is not None:
-                sb[bs:bs + blk] = native.astype(np.uint16, copy=False)
-            else:
-                for i, f in enumerate(feats):
-                    sb[bs:bs + blk, i] = self.bin_mappers[f].value_to_bin(
-                        dense[:, f])
-        bundles = find_bundles(sb, nb, can)
-        if len(bundles) >= self.num_features:
-            return
-        self.bundles = bundles
-        self.feat_bundle, self.feat_off, self.bundle_widths = \
-            bundle_layout(bundles, nb)
-        Log.info("EFB(sparse): bundled %d features into %d dense columns",
-                 self.num_features, len(bundles))
+        native = bin_values(dense, self.bin_mappers, self.used_features)
+        if native is not None:
+            return native.astype(np.uint16, copy=False)
+        bb = np.empty((dense.shape[0], len(self.used_features)), np.uint16)
+        for i, f in enumerate(self.used_features):
+            bb[:, i] = self.bin_mappers[f].value_to_bin(dense[:, f])
+        return bb
 
     # ------------------------------------------------------------------
     # EFB (io/efb.py; reference FindGroups, src/io/dataset.cpp:60-180)
-    def _apply_bundling(self) -> None:
+    def _efb_candidates(self):
+        """(num_bins, bundleable) arrays over used features, or None when
+        bundling cannot apply (disabled / feature-sharded learners / too few
+        candidates)."""
         cfg = self.config
         if (not cfg.enable_bundle or self.num_features <= 1
                 or cfg.tree_learner in ("feature", "voting")):
-            return
-        from .efb import (MAX_BUNDLE_BINS, build_bundle_matrix, bundle_layout,
-                          find_bundles)
+            return None
+        from .efb import MAX_BUNDLE_BINS
         feats = self.used_features
         nb = np.array([self.bin_mappers[f].num_bin for f in feats], np.int64)
         can = np.array([
@@ -364,21 +333,60 @@ class Dataset:
             and self.bin_mappers[f].num_bin <= MAX_BUNDLE_BINS
             for f in feats])
         if int(can.sum()) < 2:
+            return None
+        return nb, can
+
+    def _plan_bundles_from_binned(self, sb: np.ndarray) -> None:
+        """Greedy conflict-bounded bundle discovery over a binned row sample
+        (reference ``FindGroups``); sets the bundle layout fields when
+        bundling wins."""
+        cand = self._efb_candidates()
+        if cand is None:
             return
-        n = self.num_data
-        s = min(n, max(1, cfg.bin_construct_sample_cnt))
-        sample_idx = Random(cfg.data_random_seed + 1).sample(n, s)
-        bundles = find_bundles(self.bins[sample_idx], nb, can)
+        nb, can = cand
+        from .efb import bundle_layout, find_bundles
+        bundles = find_bundles(sb, nb, can)
         if len(bundles) >= self.num_features:
             return                                     # nothing bundled
-        feat_bundle, feat_off, widths = bundle_layout(bundles, nb)
+        self.bundles = bundles
+        self.feat_bundle, self.feat_off, self.bundle_widths = \
+            bundle_layout(bundles, nb)
         Log.info("EFB: bundled %d features into %d dense columns",
                  self.num_features, len(bundles))
-        self.bins = build_bundle_matrix(self.bins, bundles, feat_off, widths)
-        self.bundles = bundles
-        self.feat_bundle = feat_bundle
-        self.feat_off = feat_off
-        self.bundle_widths = widths
+
+    def _plan_bundles_from_sample(self, data) -> None:
+        """EFB layout discovery for the sparse streaming path — the binned
+        sample must be materialized first (the dense path samples its
+        already-binned matrix instead)."""
+        if self._efb_candidates() is None:
+            return
+        cfg = self.config
+        n = self.num_data
+        # conflict counting converges quickly — cap the planning sample so the
+        # binned sample matrix stays small even at Allstate width
+        s = min(n, max(1, cfg.bin_construct_sample_cnt), 50_000)
+        sample_idx = Random(cfg.data_random_seed + 1).sample(n, s)
+        sub = data[sample_idx]
+        sb = np.empty((s, len(self.used_features)), dtype=np.uint16)
+        blk = self._sparse_block_rows(self.num_total_features)
+        for bs in range(0, s, blk):
+            sb[bs:bs + blk] = self._bin_dense_block(
+                np.asarray(sub[bs:bs + blk].toarray(), np.float64))
+        self._plan_bundles_from_binned(sb)
+
+    def _apply_bundling(self) -> None:
+        """Dense path: plan from a sample of the binned matrix, then pack."""
+        if self._efb_candidates() is None:
+            return
+        from .efb import build_bundle_matrix
+        n = self.num_data
+        s = min(n, max(1, self.config.bin_construct_sample_cnt))
+        sample_idx = Random(self.config.data_random_seed + 1).sample(n, s)
+        self._plan_bundles_from_binned(self.bins[sample_idx])
+        if self.bundles is not None:
+            self.bins = build_bundle_matrix(self.bins, self.bundles,
+                                            self.feat_off,
+                                            self.bundle_widths)
 
     def _adopt_bundling(self, reference: "Dataset") -> None:
         """Validation sets pack with the training set's bundle layout."""

@@ -1,0 +1,139 @@
+"""Distributed (multi-process) binning: sharded ingest with globally
+consistent bin mappers.
+
+Reference analog: with ``pre_partition=true`` each rank loads only its own
+partition, samples it locally, and the ranks pool their samples so every
+machine constructs IDENTICAL bin boundaries before binning its local rows
+(``src/io/dataset_loader.cpp:950`` ``ConstructFromSampleData`` +
+``SyncUpGlobalBestSplit``-style allgather over the socket/MPI Network).
+
+TPU-native design: the pooling collective is
+``jax.experimental.multihost_utils.process_allgather`` over the
+``jax.distributed`` client (ICI/DCN — no hand-rolled sockets).  Every
+process then runs the exact same deterministic ``BinMapper.find_bin`` and
+EFB planning on the pooled sample, yielding bit-identical mappers and
+bundle layout with no broadcast step.  Local rows are binned with the
+native threaded binner; nothing global is ever materialized.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import Log, check
+from ..utils.random_gen import Random
+from .dataset import Dataset, Metadata, _is_sparse, _resolve_categorical
+
+
+def _allgather_samples(sample: np.ndarray) -> np.ndarray:
+    """Pool per-process row samples: pad to the global max row count (row
+    counts may differ per process), allgather, and drop the padding (the
+    gathered counts slice padding rows off before any mapper sees them, so
+    missing-value statistics stay exact)."""
+    import jax
+    from jax.experimental import multihost_utils as mhu
+
+    n_local = np.int32(sample.shape[0])
+    counts = np.asarray(mhu.process_allgather(n_local))       # [P]
+    cap = int(counts.max())
+    pad = np.zeros((cap - sample.shape[0], sample.shape[1]), np.float64)
+    padded = np.ascontiguousarray(
+        np.concatenate([sample, pad], axis=0), np.float64)
+    # gather as uint32 words: jax arrays default to 32-bit (x64 disabled),
+    # so a float64 allgather would silently round the sample to float32 and
+    # shift bin boundaries vs the single-process float64 path.  The uint32
+    # view is bit-lossless; padding rows are dropped by count either way.
+    words = padded.view(np.uint32).reshape(padded.shape[0], -1)
+    gathered = np.asarray(mhu.process_allgather(words, tiled=True),
+                          np.uint32)
+    parts = []
+    for p in range(jax.process_count()):
+        seg = gathered[p * cap: p * cap + int(counts[p])]
+        parts.append(np.ascontiguousarray(seg).view(np.float64))
+    return np.concatenate(parts, axis=0)
+
+
+def distributed_dataset(data, config: Optional[Config] = None, label=None,
+                        weight=None, group=None, init_score=None,
+                        categorical_feature: Optional[Sequence[int]] = None,
+                        feature_names: Optional[Sequence[str]] = None
+                        ) -> Dataset:
+    """Build a local-shard ``Dataset`` whose bin mappers (and EFB bundle
+    layout) are identical on every ``jax.distributed`` process.
+
+    ``data`` is THIS process's row partition (dense ndarray or scipy
+    sparse).  Requires ``jax.distributed`` to be initialized
+    (``parallel.mesh.init_distributed``); with one process it degrades to
+    the ordinary single-host constructor.
+    """
+    import jax
+
+    config = config or Config()
+    if jax.process_count() == 1:
+        return Dataset.from_data(
+            data, config, label=label, weight=weight, group=group,
+            init_score=init_score, categorical_feature=categorical_feature,
+            feature_names=feature_names)
+
+    self = Dataset(config)
+    sparse = _is_sparse(data)
+    if sparse:
+        data = data.tocsr()
+        check(not config.linear_tree,
+              "linear_tree with sparse input is not supported")
+    else:
+        data = np.ascontiguousarray(np.asarray(data, np.float64))
+        if data.ndim == 1:
+            data = data.reshape(-1, 1)
+    n_local, n_feat = data.shape
+    self.num_data = n_local
+    self.num_total_features = n_feat
+    self.feature_names = list(feature_names) if feature_names else [
+        f"Column_{i}" for i in range(n_feat)]
+
+    # --- local sample, sized by this shard's share of the global budget ---
+    from jax.experimental import multihost_utils as mhu
+    n_global = int(np.asarray(mhu.process_allgather(np.int64(n_local))).sum())
+    budget = min(n_global, config.bin_construct_sample_cnt)
+    local_cnt = max(1, min(n_local, int(round(
+        budget * (n_local / max(1, n_global))))))
+    rng = Random(config.data_random_seed + jax.process_index())
+    idx = rng.sample(n_local, local_cnt)
+    local_sample = (np.asarray(data[idx].toarray(), np.float64) if sparse
+                    else data[idx])
+
+    pooled = _allgather_samples(local_sample)
+    Log.info("distributed binning: pooled %d sample rows from %d processes",
+             pooled.shape[0], jax.process_count())
+
+    # --- identical mappers everywhere: same pooled sample, same algorithm
+    # (shared constructor, reference _construct_bin_mappers path) ---
+    cats = set(_resolve_categorical(categorical_feature, self.feature_names,
+                                    config))
+    self._construct_bin_mappers(data, cats, presampled=pooled)
+
+    # --- EFB layout from the pooled sample (deterministic -> identical) ---
+    self._plan_bundles_from_binned(self._bin_dense_block(pooled))
+    if sparse:
+        # passing self as the layout "reference" makes the streaming binner
+        # adopt the just-planned bundles (or none) instead of re-planning
+        # from local rows, which would diverge across processes
+        self._bin_data_sparse(data, self)
+    else:
+        self._bin_data(data)
+        if self.bundles is not None:
+            from .efb import build_bundle_matrix
+            self.bins = build_bundle_matrix(
+                self.bins, self.bundles, self.feat_off, self.bundle_widths)
+    if config.linear_tree and not sparse:
+        self.raw_data = np.asarray(data, np.float32)
+
+    md = Metadata(n_local)
+    self.metadata = md
+    for name, val in (("label", label), ("weight", weight), ("group", group),
+                      ("init_score", init_score)):
+        if val is not None:
+            md.set_field(name, val)
+    return self

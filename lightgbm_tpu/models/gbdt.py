@@ -446,6 +446,46 @@ class GBDT:
         mask = self._bag_mask
         return mask, grad * mask, hess * mask
 
+    # -- bagging subset (reference CopySubrow, gbdt.cpp:256): when bagging
+    # drops a material fraction of rows, compact the survivors into a
+    # fixed-capacity buffer so every grower pass costs O(cap), not O(N).
+    # The MASK still decides membership (identical trees to the masked
+    # path — the compaction is exact as long as count <= cap, and cap
+    # carries a >6-sigma margin over the Bernoulli mean), so serial,
+    # data-parallel and masked runs stay in exact parity.
+    _BAG_SUBSET_MAX_FRACTION = 0.8
+
+    def _bag_subset_capacity(self) -> Optional[int]:
+        cfg = self.config
+        n = self.train_data.num_data
+        if (cfg.bagging_freq <= 0 or not (0.0 < cfg.bagging_fraction
+                                          < self._BAG_SUBSET_MAX_FRACTION)
+                or cfg.pos_bagging_fraction < 1.0
+                or cfg.neg_bagging_fraction < 1.0
+                or getattr(self, "_mesh", None) is not None
+                or type(self)._bagging_weights is not GBDT._bagging_weights):
+            return None
+        k = n * cfg.bagging_fraction
+        cap = int(k + max(64.0, 6.0 * float(np.sqrt(k))))
+        cap = -(-cap // 1024) * 1024
+        return cap if cap < n else None
+
+    @functools.cached_property
+    def _bag_compact_jit(self):
+        from ..ops.histogram import unrolled_rank
+        n = self.train_data.num_data
+
+        @functools.partial(jax.jit, static_argnums=2)
+        def fn(mask, bins, cap):
+            cs = jnp.cumsum((mask > 0).astype(jnp.int32))
+            targets = jnp.arange(1, cap + 1, dtype=jnp.int32)
+            row_ids = jnp.minimum(unrolled_rank(cs, targets, strict=True),
+                                  n - 1)
+            filled = targets <= cs[-1]
+            rw = jnp.where(filled, jnp.take(mask, row_ids), 0.0)
+            return row_ids, rw, jnp.take(bins, row_ids, axis=0)
+        return fn
+
     def _feature_mask(self, iteration: int) -> jnp.ndarray:
         cfg = self.config
         f = self.train_data.num_features
@@ -492,7 +532,8 @@ class GBDT:
                 and not cfg.linear_tree
                 and cegb_coupled0 is None and cegb_lazy0 is None)
         if fast:
-            return self._train_one_iter_fast(g, h, row_weight, fmask, it, K)
+            return self._train_one_iter_fast(g, h, row_weight, fmask, it, K,
+                                             bag_mask=bag_mask)
 
         should_stop = True
         for k in range(K):
@@ -579,18 +620,35 @@ class GBDT:
         return should_stop
 
     def _train_one_iter_fast(self, g, h, row_weight, fmask, it: int,
-                             K: int) -> bool:
+                             K: int, bag_mask=None) -> bool:
         """Device-resident iteration: grow, score-update and valid-update all
         stay on device; the host tree materializes lazily (``models``
         property), so the boosting loop issues work without ever blocking on
         the device — the per-tree host round-trip of the synchronous path
         disappears from the critical path."""
         cfg = self.config
+        cap = self._bag_subset_capacity() if bag_mask is not None else None
+        if cap is not None:
+            if it % cfg.bagging_freq == 0 or getattr(self, "_bag_sub", None) is None:
+                self._bag_sub = self._bag_compact_jit(bag_mask, self._dd.bins,
+                                                      cap)
+            bag_rows, bag_rw, bag_bins = self._bag_sub
         for k in range(K):
             with global_timer.scope("GBDT::grow_tree"):
-                tree_arrays, node_assign = self._grow_jit(
-                    self._dd.bins, g[k], h[k], row_weight, fmask,
-                    key_for_iteration(cfg.seed, it, salt=k + 1), None, None)
+                if cap is not None:
+                    # grow over the compacted bag; leaf assignment for the
+                    # FULL training set comes from one binned traversal
+                    tree_arrays, _ = self._grow_jit(
+                        bag_bins, jnp.take(g[k], bag_rows),
+                        jnp.take(h[k], bag_rows), bag_rw, fmask,
+                        key_for_iteration(cfg.seed, it, salt=k + 1),
+                        None, None)
+                    node_assign = self._predict_leaf_jit(tree_arrays,
+                                                         self._dd.bins)
+                else:
+                    tree_arrays, node_assign = self._grow_jit(
+                        self._dd.bins, g[k], h[k], row_weight, fmask,
+                        key_for_iteration(cfg.seed, it, salt=k + 1), None, None)
             jax.tree.map(lambda a: a.copy_to_host_async(), tree_arrays)
             bias = (self.init_scores[k]
                     if it == 0 and self.init_scores[k] != 0.0 else 0.0)

@@ -1,0 +1,87 @@
+"""Bagging subset compaction (reference ``Dataset::CopySubrow`` /
+``GBDT::ResetTrainingData`` bag-buffer path, ``gbdt.cpp:256``): with
+``bagging_fraction`` below the threshold the grower runs over a compacted
+O(bag) row buffer, but the Bernoulli MASK still defines membership — so the
+trees must be bit-identical to the full-width masked run.
+"""
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.gbdt import GBDT
+
+
+def _data(n=4000, f=12, seed=5):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+         + rng.logistic(size=n) * 0.3 > 0).astype(np.float32)
+    return X, y
+
+
+def _train(X, y, subset_enabled, **extra):
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "bagging_fraction": 0.5, "bagging_freq": 2, "bagging_seed": 9,
+              "min_data_in_leaf": 5}
+    params.update(extra)
+    old = GBDT._BAG_SUBSET_MAX_FRACTION
+    GBDT._BAG_SUBSET_MAX_FRACTION = 0.8 if subset_enabled else 0.0
+    try:
+        return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    finally:
+        GBDT._BAG_SUBSET_MAX_FRACTION = old
+
+
+def test_subset_matches_masked_path():
+    X, y = _data()
+    b_sub = _train(X, y, True)
+    b_mask = _train(X, y, False)
+    np.testing.assert_allclose(b_sub.predict(X), b_mask.predict(X),
+                               rtol=1e-6, atol=1e-7)
+    # identical tree STRUCTURE (same bag membership -> same splits); float
+    # payloads may differ in the last ulp because the compacted buffer sums
+    # histogram terms in a different order
+    s, m = b_sub.model_to_string(), b_mask.model_to_string()
+    for tag in ("split_feature=", "threshold=", "leaf_count=",
+                "decision_type=", "left_child=", "right_child="):
+        assert ([l for l in s.splitlines() if l.startswith(tag)]
+                == [l for l in m.splitlines() if l.startswith(tag)]), tag
+
+
+def test_subset_engaged():
+    """The capacity gate must actually engage for this config."""
+    X, y = _data(n=8000)
+    params = {"objective": "binary", "bagging_fraction": 0.5,
+              "bagging_freq": 1, "verbose": -1}
+    booster = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2)
+    gbdt = booster._gbdt
+    cap = gbdt._bag_subset_capacity()
+    assert cap is not None and cap < 8000 and cap >= 4000
+
+
+def test_subset_not_engaged_for_posneg_or_large_fraction():
+    X, y = _data(n=1500)
+    b = lgb.train({"objective": "binary", "bagging_fraction": 0.9,
+                   "bagging_freq": 1, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    assert b._gbdt._bag_subset_capacity() is None
+    b2 = lgb.train({"objective": "binary", "pos_bagging_fraction": 0.5,
+                    "neg_bagging_fraction": 0.9, "bagging_freq": 1,
+                    "verbose": -1},
+                   lgb.Dataset(X, label=y), num_boost_round=1)
+    assert b2._gbdt._bag_subset_capacity() is None
+
+
+def test_subset_with_valid_and_early_stop():
+    X, y = _data(n=3000)
+    tr, va = slice(0, 2200), slice(2200, 3000)
+    hist = {}
+    dtrain = lgb.Dataset(X[tr], label=y[tr])
+    b = lgb.train({"objective": "binary", "metric": "auc",
+                   "bagging_fraction": 0.4, "bagging_freq": 1,
+                   "num_leaves": 15, "verbose": -1},
+                  dtrain, num_boost_round=12,
+                  valid_sets=[lgb.Dataset(X[va], label=y[va],
+                                          reference=dtrain)],
+                  callbacks=[lgb.record_evaluation(hist)])
+    aucs = hist["valid_0"]["auc"]
+    assert len(aucs) == 12 and aucs[-1] > 0.75

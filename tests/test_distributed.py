@@ -90,20 +90,71 @@ print("proc{} OK nl={} checksum={:.6f}".format(
 """
 
 
-def test_two_process_data_parallel_step(tmp_path):
+_BINNING_WORKER = r"""
+import hashlib, json, os, sys
+import numpy as np
+
+proc_id = int(sys.argv[1])
+coord = sys.argv[2]
+sys.path.insert(0, "@REPO@")
+from lightgbm_tpu.parallel.mesh import init_distributed
+init_distributed(coordinator_address=coord, num_processes=2,
+                 process_id=proc_id)
+import jax
+assert jax.process_count() == 2
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.distributed import distributed_dataset
+
+# both processes generate the same global data, then keep disjoint halves
+# with DIFFERENT distributions per half (so pooled-vs-local binning differs)
+rng = np.random.default_rng(42)
+n, f = 4000, 12
+X = rng.normal(size=(n, f))
+X[: n // 2] *= 3.0                      # half 0 is wide, half 1 narrow
+X[:, 3] = rng.integers(0, 6, n)         # a categorical-ish column
+y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+lo, hi = (0, n // 2) if proc_id == 0 else (n // 2, n)
+
+cfg = Config.from_params({"max_bin": 63, "min_data_in_bin": 1})
+ds = distributed_dataset(X[lo:hi], cfg, label=y[lo:hi],
+                         categorical_feature=[3])
+state = json.dumps([m.to_state() for m in ds.bin_mappers], sort_keys=True)
+h = hashlib.sha256(state.encode()).hexdigest()[:16]
+print("proc{} MAPPERHASH {}".format(proc_id, h))
+
+# local binning is exactly value_to_bin of the shared mappers
+for i, feat in enumerate(ds.used_features[:4]):
+    manual = ds.bin_mappers[feat].value_to_bin(X[lo:hi, feat])
+    got = ds.unbundled_bins()[:, i]
+    assert np.array_equal(got.astype(np.int64), manual.astype(np.int64)), feat
+
+# sparse shard path agrees with dense shard path (same pooled mappers)
+import scipy.sparse as sps
+Xs = X.copy(); Xs[np.abs(Xs) < 1.0] = 0.0
+ds_d = distributed_dataset(Xs[lo:hi], cfg, label=y[lo:hi])
+ds_s = distributed_dataset(sps.csr_matrix(Xs[lo:hi]), cfg, label=y[lo:hi])
+assert np.array_equal(np.asarray(ds_d.bins), np.asarray(ds_s.bins))
+hs = hashlib.sha256(json.dumps(
+    [m.to_state() for m in ds_s.bin_mappers],
+    sort_keys=True).encode()).hexdigest()[:16]
+print("proc{} SPARSEHASH {}".format(proc_id, hs))
+print("proc{} BINOK".format(proc_id))
+"""
+
+
+def _run_two_procs(tmp_path, src, timeout=240):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     coord = f"127.0.0.1:{port}"
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER.replace("@REPO@", REPO))
-
+    script.write_text(src.replace("@REPO@", REPO))
     procs = []
     for pid in (0, 1):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = REPO
-        # one device per process -> the 2-device mesh spans processes
         env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
         env.pop("_LGBM_TPU_DRYRUN_CHILD", None)
         procs.append(subprocess.Popen(
@@ -112,10 +163,29 @@ def test_two_process_data_parallel_step(tmp_path):
             text=True))
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=240)
+        out, _ = p.communicate(timeout=timeout)
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc{pid} failed:\n{out}"
+    return outs
+
+
+def test_two_process_distributed_binning(tmp_path):
+    """Sharded ingest: mappers and EFB layout must be bit-identical across
+    processes even though each shard's local distribution differs
+    (reference: pooled-sample construction, dataset_loader.cpp:950)."""
+    outs = _run_two_procs(tmp_path, _BINNING_WORKER)
+    for pid, out in enumerate(outs):
+        assert f"proc{pid} BINOK" in out, out
+    for tag in ("MAPPERHASH", "SPARSEHASH"):
+        hashes = sorted(line.split()[-1] for out in outs
+                        for line in out.splitlines() if tag in line)
+        assert len(hashes) == 2 and hashes[0] == hashes[1], (tag, outs)
+
+
+def test_two_process_data_parallel_step(tmp_path):
+    outs = _run_two_procs(tmp_path, _WORKER)
+    for pid, out in enumerate(outs):
         assert f"proc{pid} OK" in out, out
     # both processes computed the same (replicated) tree
     chk = [line for out in outs for line in out.splitlines()
