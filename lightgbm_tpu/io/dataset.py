@@ -190,43 +190,46 @@ class Dataset:
         return self
 
     # ------------------------------------------------------------------
-    def _construct_bin_mappers(self, data, cats: set,
-                               presampled: Optional[np.ndarray] = None
-                               ) -> None:
+    def _construct_bin_mappers(self, data, cats: set) -> None:
         cfg = self.config
         n = self.num_data
         # row sampling for bin construction (reference bin_construct_sample_cnt,
         # dataset_loader.cpp SampleTextDataFromFile:902)
-        if presampled is not None:
-            # distributed ingest: the pooled cross-process sample is given
-            sample_cnt = presampled.shape[0]
-            col = lambda f: presampled[:, f]  # noqa: E731
+        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+        rng = Random(cfg.data_random_seed)
+        sample_idx = rng.sample(n, sample_cnt)
+        if _is_sparse(data):
+            # column-at-a-time densification: O(sample_cnt) per feature,
+            # never the full [sample, F] dense sample (which for
+            # Allstate-shaped data would itself exceed the binned matrix)
+            sample_csc = data[sample_idx].tocsc()
+            col = lambda f: np.asarray(  # noqa: E731
+                sample_csc[:, [f]].toarray(), np.float64).ravel()
         else:
-            sample_cnt = min(n, cfg.bin_construct_sample_cnt)
-            rng = Random(cfg.data_random_seed)
-            sample_idx = rng.sample(n, sample_cnt)
-            if _is_sparse(data):
-                # column-at-a-time densification: O(sample_cnt) per feature,
-                # never the full [sample, F] dense sample (which for
-                # Allstate-shaped data would itself exceed the binned matrix)
-                sample_csc = data[sample_idx].tocsc()
-                col = lambda f: np.asarray(  # noqa: E731
-                    sample_csc[:, [f]].toarray(), np.float64).ravel()
-            else:
-                sample = data[sample_idx]
-                col = lambda f: sample[:, f]  # noqa: E731
+            sample = data[sample_idx]
+            col = lambda f: sample[:, f]  # noqa: E731
 
-        max_bin_by_feat = cfg.max_bin_by_feature
-        self.bin_mappers = []
-        for f in range(self.num_total_features):
-            fb = max_bin_by_feat[f] if f < len(max_bin_by_feat) else cfg.max_bin
-            bt = BinType.CATEGORICAL if f in cats else BinType.NUMERICAL
-            m = BinMapper.find_bin(
-                col(f), sample_cnt, fb, cfg.min_data_in_bin,
-                cfg.min_data_in_leaf, cfg.feature_pre_filter, bin_type=bt,
-                use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing)
-            self.bin_mappers.append(m)
-        self.used_features = [f for f, m in enumerate(self.bin_mappers) if not m.is_trivial]
+        self.bin_mappers = [
+            self._find_bin_one(f, col(f), sample_cnt, cats)
+            for f in range(self.num_total_features)]
+        self._finalize_used_features()
+
+    def _find_bin_one(self, f: int, values: np.ndarray, sample_cnt: int,
+                      cats: set) -> BinMapper:
+        """Config-resolved ``BinMapper.find_bin`` for one feature (shared by
+        single-host and distributed mapper construction)."""
+        cfg = self.config
+        mbf = cfg.max_bin_by_feature
+        fb = mbf[f] if f < len(mbf) else cfg.max_bin
+        bt = BinType.CATEGORICAL if f in cats else BinType.NUMERICAL
+        return BinMapper.find_bin(
+            values, sample_cnt, fb, cfg.min_data_in_bin,
+            cfg.min_data_in_leaf, cfg.feature_pre_filter, bin_type=bt,
+            use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing)
+
+    def _finalize_used_features(self) -> None:
+        self.used_features = [f for f, m in enumerate(self.bin_mappers)
+                              if not m.is_trivial]
         if not self.used_features:
             Log.warning("There are no meaningful features, as all feature values are constant.")
         self.real_to_inner = {f: i for i, f in enumerate(self.used_features)}

@@ -27,24 +27,21 @@ from ..utils.random_gen import Random
 from .dataset import Dataset, Metadata, _is_sparse, _resolve_categorical
 
 
-def _allgather_samples(sample: np.ndarray) -> np.ndarray:
-    """Pool per-process row samples: pad to the global max row count (row
-    counts may differ per process), allgather, and drop the padding (the
-    gathered counts slice padding rows off before any mapper sees them, so
-    missing-value statistics stay exact)."""
+def _allgather_block(block: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Pool one per-process [rows, FB] float64 sample block: pad rows to the
+    global max (row counts differ per process), allgather, drop padding.
+
+    Gathered as uint32 words: jax arrays default to 32-bit (x64 disabled),
+    so a float64 allgather would silently round the sample to float32 and
+    shift bin boundaries vs the single-process float64 path.  The uint32
+    view is bit-lossless; padding rows are dropped by count either way."""
     import jax
     from jax.experimental import multihost_utils as mhu
 
-    n_local = np.int32(sample.shape[0])
-    counts = np.asarray(mhu.process_allgather(n_local))       # [P]
     cap = int(counts.max())
-    pad = np.zeros((cap - sample.shape[0], sample.shape[1]), np.float64)
+    pad = np.zeros((cap - block.shape[0], block.shape[1]), np.float64)
     padded = np.ascontiguousarray(
-        np.concatenate([sample, pad], axis=0), np.float64)
-    # gather as uint32 words: jax arrays default to 32-bit (x64 disabled),
-    # so a float64 allgather would silently round the sample to float32 and
-    # shift bin boundaries vs the single-process float64 path.  The uint32
-    # view is bit-lossless; padding rows are dropped by count either way.
+        np.concatenate([block, pad], axis=0), np.float64)
     words = padded.view(np.uint32).reshape(padded.shape[0], -1)
     gathered = np.asarray(mhu.process_allgather(words, tiled=True),
                           np.uint32)
@@ -93,29 +90,62 @@ def distributed_dataset(data, config: Optional[Config] = None, label=None,
     self.feature_names = list(feature_names) if feature_names else [
         f"Column_{i}" for i in range(n_feat)]
 
-    # --- local sample, sized by this shard's share of the global budget ---
+    # --- shard agreement: every process must bring the same feature count
+    # (a mismatched hand-partitioned file would otherwise abort deep inside
+    # the allgather with an XLA shape error, or hang the collective) ---
     from jax.experimental import multihost_utils as mhu
+    feat_counts = np.asarray(mhu.process_allgather(np.int64(n_feat)))
+    check(int(feat_counts.min()) == int(feat_counts.max()),
+          "distributed shards disagree on feature count: %s" %
+          feat_counts.tolist())
+
+    # --- local sample, sized by this shard's share of the global budget ---
     n_global = int(np.asarray(mhu.process_allgather(np.int64(n_local))).sum())
     budget = min(n_global, config.bin_construct_sample_cnt)
     local_cnt = max(1, min(n_local, int(round(
         budget * (n_local / max(1, n_global))))))
     rng = Random(config.data_random_seed + jax.process_index())
     idx = rng.sample(n_local, local_cnt)
-    local_sample = (np.asarray(data[idx].toarray(), np.float64) if sparse
-                    else data[idx])
+    local_sample = data[idx]          # sparse stays sparse until blocked
+    if sparse:
+        local_sample = local_sample.tocsc()
+    counts = np.asarray(mhu.process_allgather(np.int32(local_cnt)))
+    s_global = int(counts.sum())
+    Log.info("distributed binning: pooling %d sample rows from %d processes",
+             s_global, jax.process_count())
 
-    pooled = _allgather_samples(local_sample)
-    Log.info("distributed binning: pooled %d sample rows from %d processes",
-             pooled.shape[0], jax.process_count())
-
-    # --- identical mappers everywhere: same pooled sample, same algorithm
-    # (shared constructor, reference _construct_bin_mappers path) ---
+    # --- identical mappers everywhere, streamed over FEATURE blocks so the
+    # pooled dense sample never exists whole (the reference pools per-rank
+    # samples the same way but stores them columnar,
+    # dataset_loader.cpp:950); each pooled block also feeds the EFB
+    # planning sample while it is alive ---
     cats = set(_resolve_categorical(categorical_feature, self.feature_names,
                                     config))
-    self._construct_bin_mappers(data, cats, presampled=pooled)
+    fb_cols = max(1, min(n_feat,
+                         Dataset._SPARSE_BLOCK_BYTES // max(1, 8 * s_global)))
+    want_efb = (config.enable_bundle and n_feat > 1
+                and config.tree_learner not in ("feature", "voting"))
+    s_efb = min(s_global, 50_000)     # same planning cap as the sparse path
+    sb = np.empty((s_efb, n_feat), np.uint16) if want_efb else None
+    self.bin_mappers = []
+    for f0 in range(0, n_feat, fb_cols):
+        f1 = min(n_feat, f0 + fb_cols)
+        blk = local_sample[:, f0:f1]
+        blk = np.asarray(blk.toarray() if sparse else blk, np.float64)
+        pooled = _allgather_block(np.ascontiguousarray(blk), counts)
+        for j in range(f0, f1):
+            self.bin_mappers.append(self._find_bin_one(
+                j, pooled[:, j - f0], s_global, cats))
+            if sb is not None:
+                sb[:, j] = self.bin_mappers[j].value_to_bin(
+                    pooled[:s_efb, j - f0]).astype(np.uint16)
+    self._finalize_used_features()
 
-    # --- EFB layout from the pooled sample (deterministic -> identical) ---
-    self._plan_bundles_from_binned(self._bin_dense_block(pooled))
+    # --- EFB layout from the pooled binned sample (deterministic ->
+    # identical on every process) ---
+    if sb is not None and self.used_features:
+        self._plan_bundles_from_binned(
+            np.ascontiguousarray(sb[:, self.used_features]))
     if sparse:
         # passing self as the layout "reference" makes the streaming binner
         # adopt the just-planned bundles (or none) instead of re-planning

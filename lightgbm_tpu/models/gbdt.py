@@ -180,10 +180,6 @@ class GBDT:
                 "tree_learner=%s requested but only one device is available; "
                 "training serially", tl)
             return
-        if cfg.forcedsplits_filename and tl in ("feature", "voting"):
-            raise LightGBMError(
-                "forced splits are not supported with the feature/voting "
-                "parallel tree learners")
         if tl in ("feature", "voting") and self._dd.efb is not None:
             # the Dataset disables bundling when its params request these
             # learners; a dataset constructed for serial/data training and
@@ -732,7 +728,8 @@ class GBDT:
                 return grow_tree(bins, g, h, rw, fmask, num_bins, default_bins,
                                  nan_bins, is_cat, mono, key, cfg,
                                  interaction_sets=inter_p, cegb_coupled=cc,
-                                 cegb_lazy=lazy_p, cegb_used_data=cu)
+                                 cegb_lazy=lazy_p, cegb_used_data=cu,
+                                 forced=forced)
 
             sharded = jax.shard_map(
                 grow, mesh=mesh,

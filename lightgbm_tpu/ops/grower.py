@@ -349,11 +349,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # data_partition.hpp:21-170).  Per split, only the parent's contiguous
     # segment is touched: every O(N)-per-split pass (leaf masks, decision
     # vectors, compaction searches) collapses to O(parent rows), bucketed by
-    # the same capacity ladder.  Disabled for feature/voting parallel modes
-    # (shard decisions there ride full-row vectors) and for CEGB-lazy (its
-    # per-row cost bitset needs leaf masks).
+    # the same capacity ladder.  Feature mode broadcasts the owner shard's
+    # split column per segment (see partition_and_hist); voting partitions
+    # its local row shard exactly like data mode.  Disabled only for
+    # CEGB-lazy (its per-row cost bitset needs leaf masks).
     use_partition = (cfg.hist_compact and len(caps) > 1
-                     and mode in (None, "data") and cegb_lazy is None)
+                     and cegb_lazy is None)
 
     def _seg_window(begin, cap):
         """Clamped cap-sized window covering [begin, begin+cap) and the
@@ -413,11 +414,26 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 ghb = _unpack_gh(combb)                   # [cap, 3]
                 # split column via one-hot reduce — a dynamic minor-axis
                 # take would relayout the whole block
-                col_id = col_of_feat[feat] if efb is not None else feat
-                fsel = (jnp.arange(combb.shape[1], dtype=jnp.int32) == col_id)
-                colv = split_column_bins(
-                    jnp.sum(combb.astype(jnp.int32) * fsel[None, :], axis=1),
-                    feat)
+                if mode == "feature":
+                    # columns are sharded: the owner selects its local
+                    # column, the psum broadcasts it.  The collective is
+                    # safe INSIDE the cap switch only because feature mode
+                    # replicates rows — begin/rows (hence the switch index)
+                    # are identical on every shard.
+                    local_ix = jnp.clip(feat - f_start, 0, f - 1)
+                    fsel = ((jnp.arange(combb.shape[1], dtype=jnp.int32)
+                             == local_ix)
+                            & (feat >= f_start) & (feat < f_start + f))
+                    colv = jax.lax.psum(
+                        jnp.sum(combb.astype(jnp.int32) * fsel[None, :],
+                                axis=1), axis)
+                else:
+                    col_id = col_of_feat[feat] if efb is not None else feat
+                    fsel = (jnp.arange(combb.shape[1], dtype=jnp.int32)
+                            == col_id)
+                    colv = split_column_bins(
+                        jnp.sum(combb.astype(jnp.int32) * fsel[None, :],
+                                axis=1), feat)
                 is_miss = (colv == nan_bins[feat]) & (nan_bins[feat] >= 0)
                 gl = jnp.where(f_is_cat, bitset_contains(cbits, colv),
                                jnp.where(is_miss, dleft, colv <= thr))
@@ -705,8 +721,25 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     def forced_split_info(st, leaf, feat, thr):
         """SplitInfo for a forced (feature, threshold-bin) split of a leaf,
         from its stored histogram (the reference's
-        ``GatherInfoForThreshold``, feature_histogram.hpp)."""
-        h = expand_hist(st["hist"][leaf])[feat]                      # [B, 3]
+        ``GatherInfoForThreshold``, feature_histogram.hpp).
+
+        Parallel modes (``feat`` is a static global id): under feature
+        parallel only the shard owning the feature's histogram computes the
+        info and the result is pmax-broadcast; under voting parallel the
+        histogram store is shard-local, so the forced feature's column is
+        psum'd first and every shard computes identically (the reference
+        runs ForceSplits on every rank over full local histograms —
+        serial_tree_learner.cpp:543 — which feature-sharded storage here
+        replaces)."""
+        owns = None
+        if mode == "feature":
+            local_ix = jnp.clip(feat - f_start, 0, f - 1)
+            owns = (feat >= f_start) & (feat < f_start + f)
+            h = expand_hist(st["hist"][leaf])[local_ix]              # [B, 3]
+        elif mode == "voting":
+            h = jax.lax.psum(expand_hist(st["hist"][leaf])[feat], axis)
+        else:
+            h = expand_hist(st["hist"][leaf])[feat]                  # [B, 3]
         total = jnp.stack([st["leaf_sum_g"][leaf], st["leaf_weight"][leaf],
                            st["leaf_count"][leaf]])
         bin_ids = jnp.arange(B)
@@ -730,7 +763,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # the reference gates forced splits only on the gain threshold
         # (min_gain_to_split), not on min-data/min-hessian
         ok = gain > p.min_gain_to_split
-        return SplitResult(
+        if owns is not None:
+            ok = ok & owns
+        res = SplitResult(
             gain=jnp.where(ok, gain, NEG_INF),
             feature=jnp.int32(feat), threshold=jnp.int32(thr),
             default_left=~f_cat,
@@ -740,6 +775,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             cat_bits=jnp.where(
                 f_cat, pack_bin_bitset(jnp.arange(B, dtype=jnp.int32) == thr),
                 jnp.zeros(cw, jnp.int32)))
+        if owns is not None:
+            res = _reduce_split_global(res, axis)
+        return res
 
     def apply_split(j, st, leaf, gain, ok):
         """Apply the pending best split of ``leaf`` as node ``j``.
@@ -1026,10 +1064,6 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # and node slots, so a forced split that fails its gates leaves no gap in
     # the node arrays and does not shift later siblings' leaf numbering),
     # then best-gain growth
-    if forced and mode in ("feature", "voting"):
-        raise NotImplementedError(
-            "forced splits are not supported with the feature/voting "
-            "parallel learners (shard-local histograms)")
     forced_ok = []
     forced_leaf_id = []      # traced leaf id each forced node targets
     forced_right_id = []     # traced leaf id of each forced node's right child

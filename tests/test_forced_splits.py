@@ -95,3 +95,44 @@ def test_forced_nested_after_failure(regression_data, tmp_path):
     assert root["split_feature"] == 0
     # the right-subtree forced split must still land on feature 2
     assert root["right_child"].get("split_feature") == 2
+
+
+@pytest.mark.parametrize("learner", ["data", "feature", "voting"])
+def test_forced_splits_parallel_matches_serial(learner, tmp_path):
+    """Forced splits must work under every parallel learner and reproduce
+    the serial tree (reference ForceSplits runs on all ranks,
+    serial_tree_learner.cpp:543; here the forced feature's histogram is
+    owner-computed/psum'd across shards — ops/grower.py forced_split_info)."""
+    rng = np.random.default_rng(11)
+    n = 1001 if learner != "feature" else 1000
+    X = rng.normal(size=(n, 8))
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.3 * rng.normal(size=n) > 0.3
+         ).astype(np.float64)
+    fs = tmp_path / "forced.json"
+    fs.write_text(json.dumps(
+        {"feature": 3, "threshold": 0.2,
+         "left": {"feature": 5, "threshold": -0.4}}))
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "max_bin": 63, "verbose": -1, "seed": 7,
+              "forcedsplits_filename": str(fs)}
+
+    def train(tl):
+        import lightgbm_tpu as lgb
+        p = dict(params, tree_learner=tl)
+        return lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                         num_boost_round=4)
+
+    serial = train("serial")
+    par = train(learner)
+    # the forced (feature, bin-threshold) pair must appear at the root
+    dumped = serial.dump_model()["tree_info"][0]["tree_structure"]
+    assert dumped["split_feature"] == 3
+    np.testing.assert_allclose(par.predict(X), serial.predict(X),
+                               rtol=0, atol=1e-6)
+    struct_keys = ("split_feature=", "threshold=", "left_child=",
+                   "right_child=", "leaf_count=")
+
+    def structure(s):
+        return [l for l in s.splitlines() if l.startswith(struct_keys)]
+    assert structure(par.model_to_string()) == structure(
+        serial.model_to_string())
