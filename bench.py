@@ -33,7 +33,44 @@ def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 42):
     return X, y
 
 
+def _ensure_live_backend() -> bool:
+    """Probe the ambient JAX backend in a SUBPROCESS before committing this
+    process to it.  The axon TPU tunnel, when wedged by a previous killed
+    client, hangs every jax init rather than erroring — a hung bench records
+    nothing.  If the probe can't complete, re-exec on the CPU backend with
+    an explicit flag so the output is still one honest JSON line (detail
+    carries ``tpu_unreachable: true``).  Returns True when the ambient
+    backend is usable."""
+    import subprocess
+    if os.environ.get("_BENCH_REEXEC"):
+        return True
+    if "axon" not in os.environ.get("JAX_PLATFORMS", "axon"):
+        return True
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "(jnp.ones((64,64)) @ jnp.ones((64,64))).block_until_ready();"
+             "print('live')"],
+            timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", 300)),
+            capture_output=True, text=True)
+        if "live" in (r.stdout or ""):
+            return True
+    except subprocess.TimeoutExpired:
+        pass
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    bench_dir = os.path.dirname(os.path.abspath(__file__))
+    prev_pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+               if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([bench_dir] + prev_pp)
+    env["_BENCH_REEXEC"] = "tpu_unreachable"
+    env.setdefault("BENCH_ROWS", "200000")      # CPU fallback: keep it sane
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
 def main() -> None:
+    _ensure_live_backend()
     n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
     n_iters = int(os.environ.get("BENCH_ITERS", 20))
     n_warmup = int(os.environ.get("BENCH_WARMUP", 2))
@@ -93,6 +130,8 @@ def main() -> None:
             "sec_per_tree": round(sec_per_tree, 4),
             "auc": round(auc, 6),
             "backend": __import__("jax").default_backend(),
+            **({"tpu_unreachable": True}
+               if os.environ.get("_BENCH_REEXEC") else {}),
         },
     }))
 

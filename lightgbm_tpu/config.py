@@ -342,6 +342,10 @@ class Config:
             if key in fields and not key.startswith("_"):
                 setattr(self, key, self._coerce(key, value))
             else:
+                if key != "config":     # CLI pseudo-param, handled upstream
+                    # reference logs every unrecognized key ("Unknown
+                    # parameter", config.cpp) instead of dropping it
+                    Log.warning("Unknown parameter: %s", key)
                 self._unknown[key] = value
 
     def _coerce(self, key: str, value: Any) -> Any:

@@ -319,13 +319,20 @@ class Dataset:
 
     # ------------------------------------------------------------------
     # EFB (io/efb.py; reference FindGroups, src/io/dataset.cpp:60-180)
+    @staticmethod
+    def _efb_config_allows(cfg, num_features: int) -> bool:
+        """Config-only part of the EFB gate (shared with distributed
+        ingest, which must decide before binning whether to collect a
+        planning sample)."""
+        return (cfg.enable_bundle and num_features > 1
+                and cfg.tree_learner not in ("feature", "voting"))
+
     def _efb_candidates(self):
         """(num_bins, bundleable) arrays over used features, or None when
         bundling cannot apply (disabled / feature-sharded learners / too few
         candidates)."""
         cfg = self.config
-        if (not cfg.enable_bundle or self.num_features <= 1
-                or cfg.tree_learner in ("feature", "voting")):
+        if not self._efb_config_allows(cfg, self.num_features):
             return None
         from .efb import MAX_BUNDLE_BINS
         feats = self.used_features

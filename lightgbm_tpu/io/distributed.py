@@ -123,10 +123,12 @@ def distributed_dataset(data, config: Optional[Config] = None, label=None,
                                     config))
     fb_cols = max(1, min(n_feat,
                          Dataset._SPARSE_BLOCK_BYTES // max(1, 8 * s_global)))
-    want_efb = (config.enable_bundle and n_feat > 1
-                and config.tree_learner not in ("feature", "voting"))
-    s_efb = min(s_global, 50_000)     # same planning cap as the sparse path
-    sb = np.empty((s_efb, n_feat), np.uint16) if want_efb else None
+    want_efb = Dataset._efb_config_allows(config, n_feat)
+    # planning rows STRIDED over the whole pooled sample (a prefix would be
+    # process 0's rows only — biased for non-IID shards); same 50k cap as
+    # the single-host sparse path
+    efb_rows = np.arange(s_global)[::max(1, -(-s_global // 50_000))]
+    sb = np.empty((len(efb_rows), n_feat), np.uint16) if want_efb else None
     self.bin_mappers = []
     for f0 in range(0, n_feat, fb_cols):
         f1 = min(n_feat, f0 + fb_cols)
@@ -138,7 +140,7 @@ def distributed_dataset(data, config: Optional[Config] = None, label=None,
                 j, pooled[:, j - f0], s_global, cats))
             if sb is not None:
                 sb[:, j] = self.bin_mappers[j].value_to_bin(
-                    pooled[:s_efb, j - f0]).astype(np.uint16)
+                    pooled[efb_rows, j - f0]).astype(np.uint16)
     self._finalize_used_features()
 
     # --- EFB layout from the pooled binned sample (deterministic ->

@@ -1,0 +1,115 @@
+"""Generate docs/Parameters.md from the Config dataclass + alias table.
+
+The reference generates its Parameters.rst from config.h field comments via
+``helpers/parameter_generator.py`` and CI-diffs the two (SURVEY §2.2 item
+"generated accessors/docs").  Here the single source of truth is
+``lightgbm_tpu/config.py``: this script renders every field with its type,
+default and aliases, grouped by the section comments in the dataclass
+source, and ``tests/test_param_docs.py`` asserts the rendered doc stays in
+sync with the dataclass (the CI-diff analog).
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import os
+import re
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightgbm_tpu.config import PARAM_ALIASES, Config  # noqa: E402
+
+
+def field_sections():
+    """Map field name -> section title, parsed from ``# -- section --``
+    comments in the dataclass source."""
+    src = inspect.getsource(Config)
+    section = "core"
+    out = {}
+    for line in src.splitlines():
+        m = re.match(r"\s*# -- (.+?) \(", line)
+        if m:
+            section = m.group(1)
+            continue
+        m = re.match(r"\s*(\w+)\s*:", line)
+        if m and not line.strip().startswith("#"):
+            out[m.group(1)] = section
+    return out
+
+
+def aliases_by_field():
+    rev = defaultdict(list)
+    for alias, canonical in PARAM_ALIASES.items():
+        rev[canonical].append(alias)
+    return {k: sorted(v) for k, v in rev.items()}
+
+
+def _fmt_default(v):
+    if isinstance(v, str):
+        return f'``"{v}"``' if v else "``\"\"``"
+    if isinstance(v, (list, tuple)):
+        return "``[]``" if not v else f"``{list(v)}``"
+    return f"``{v}``"
+
+
+def render() -> str:
+    sections = field_sections()
+    rev = aliases_by_field()
+    by_section = defaultdict(list)
+    for f in dataclasses.fields(Config):
+        by_section[sections.get(f.name, "other")].append(f)
+
+    lines = [
+        "# Parameters",
+        "",
+        "Generated from `lightgbm_tpu/config.py` by"
+        " `scripts/gen_param_docs.py` — do not edit by hand"
+        " (`python scripts/gen_param_docs.py` regenerates;"
+        " `tests/test_param_docs.py` keeps it in sync, the analog of the"
+        " reference's `helpers/parameter_generator.py` + CI diff).",
+        "",
+        "Aliases follow the reference's `Parameters.rst`; unrecognized"
+        " parameters are warned about and ignored, as in the reference.",
+        "",
+    ]
+    # CLI-level pseudo-parameters: consumed by application.py before
+    # Config.from_params ever sees them (reference: config= on the CLI)
+    lines.append("## CLI-level")
+    lines.append("")
+    lines.append("| parameter | type | default | aliases |")
+    lines.append("|---|---|---|---|")
+    lines.append("| `config` | str | ``\"\"`` | `config_file` |")
+    lines.append("")
+    for section, fs in by_section.items():
+        lines.append(f"## {section}")
+        lines.append("")
+        lines.append("| parameter | type | default | aliases |")
+        lines.append("|---|---|---|---|")
+        for f in fs:
+            ftype = (f.type if isinstance(f.type, str)
+                     else getattr(f.type, "__name__", str(f.type)))
+            if f.default is not dataclasses.MISSING:
+                d = _fmt_default(f.default)
+            else:
+                d = _fmt_default(f.default_factory())
+            al = ", ".join(f"`{a}`" for a in rev.get(f.name, [])) or "—"
+            lines.append(f"| `{f.name}` | {ftype} | {d} | {al} |")
+        lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+def main() -> int:
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "Parameters.md")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    text = render()
+    with open(out, "w") as fh:
+        fh.write(text)
+    print(f"wrote {out} ({len(text.splitlines())} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""Parameter-docs generation stays in sync with the Config dataclass —
+the analog of the reference's CI check that ``Parameters.rst`` matches
+``config.h`` (``.ci/check-docs.sh`` + ``helpers/parameter_generator.py``).
+"""
+import dataclasses
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from lightgbm_tpu.config import PARAM_ALIASES, Config
+
+
+def _render():
+    import gen_param_docs
+    return gen_param_docs.render()
+
+
+def test_docs_file_matches_generator():
+    path = os.path.join(REPO, "docs", "Parameters.md")
+    assert os.path.exists(path), (
+        "docs/Parameters.md missing — run scripts/gen_param_docs.py")
+    assert open(path).read() == _render(), (
+        "docs/Parameters.md is stale — rerun scripts/gen_param_docs.py")
+
+
+def test_every_config_field_documented():
+    doc = _render()
+    for f in dataclasses.fields(Config):
+        assert f"`{f.name}`" in doc, f.name
+
+
+def test_every_alias_documented():
+    doc = _render()
+    for alias, canonical in PARAM_ALIASES.items():
+        assert f"`{alias}`" in doc, (alias, canonical)
+
+
+def test_aliases_point_at_real_fields():
+    # "config" is a CLI-level pseudo-parameter consumed by application.py
+    names = {f.name for f in dataclasses.fields(Config)} | {"config"}
+    for alias, canonical in PARAM_ALIASES.items():
+        assert canonical in names, (alias, canonical)
