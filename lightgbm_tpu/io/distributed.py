@@ -124,11 +124,13 @@ def distributed_dataset(data, config: Optional[Config] = None, label=None,
     fb_cols = max(1, min(n_feat,
                          Dataset._SPARSE_BLOCK_BYTES // max(1, 8 * s_global)))
     want_efb = Dataset._efb_config_allows(config, n_feat)
-    # planning rows STRIDED over the whole pooled sample (a prefix would be
-    # process 0's rows only — biased for non-IID shards); same 50k cap as
-    # the single-host sparse path
-    efb_rows = np.arange(s_global)[::max(1, -(-s_global // 50_000))]
-    sb = np.empty((len(efb_rows), n_feat), np.uint16) if want_efb else None
+    sb = efb_rows = None
+    if want_efb:
+        # planning rows STRIDED over the whole pooled sample (a prefix
+        # would be process 0's rows only — biased for non-IID shards);
+        # same 50k cap as the single-host sparse path
+        efb_rows = np.arange(s_global)[::max(1, -(-s_global // 50_000))]
+        sb = np.empty((len(efb_rows), n_feat), np.uint16)
     self.bin_mappers = []
     for f0 in range(0, n_feat, fb_cols):
         f1 = min(n_feat, f0 + fb_cols)

@@ -62,6 +62,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
         i32p = ctypes.POINTER(ctypes.c_int32)
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.SetParserSectionBytes.argtypes = [ctypes.c_int64]
+        lib.SetParserSectionBytes.restype = None
         lib.CountDelimited.argtypes = [ctypes.c_char_p, ctypes.c_char,
                                        ctypes.c_int, i64p, i64p]
         lib.ParseDelimited.argtypes = [ctypes.c_char_p, ctypes.c_char,

@@ -93,6 +93,61 @@ bool read_file(const char* path, std::vector<char>* out) {
   return true;
 }
 
+// ---- PipelineReader: double-buffered read-ahead --------------------------
+// Analog of the reference's PipelineReader
+// (include/LightGBM/utils/pipeline_reader.h): a background thread reads
+// section k+1 while the caller parses section k, so IO and parsing overlap
+// and peak memory is two sections, not the whole file.
+class PipelineReader {
+ public:
+  PipelineReader(const char* path, size_t section_bytes)
+      : f_(std::fopen(path, "rb")), section_(section_bytes) {}
+  ~PipelineReader() {
+    if (io_.joinable()) io_.join();
+    if (f_) std::fclose(f_);
+  }
+  bool ok() const { return f_ != nullptr; }
+  // true if any fread failed mid-stream (EOF is not an error)
+  bool io_error() const { return error_; }
+
+  // Hand the caller the next section; the following section's read is
+  // already in flight when this returns.  False at EOF.  The returned
+  // pointer stays valid until the next acquire() call.
+  bool acquire(const char** data, size_t* n) {
+    if (!started_) {
+      fill(front_);
+      started_ = true;
+    } else {
+      io_.join();
+      front_ ^= 1;              // the prefetched buffer becomes current
+    }
+    if (len_[front_] == 0) return false;
+    *data = buf_[front_].data();
+    *n = len_[front_];
+    int back = front_ ^ 1;
+    io_ = std::thread([this, back] { fill(back); });
+    return true;
+  }
+
+ private:
+  void fill(int idx) {
+    buf_[idx].resize(section_);
+    len_[idx] = f_ ? std::fread(buf_[idx].data(), 1, section_, f_) : 0;
+    if (f_ && len_[idx] < section_ && std::ferror(f_)) error_ = true;
+  }
+  FILE* f_;
+  size_t section_;
+  std::vector<char> buf_[2];
+  size_t len_[2] = {0, 0};
+  int front_ = 0;
+  bool started_ = false;
+  bool error_ = false;
+  std::thread io_;
+};
+
+// mutable: tests shrink it via SetParserSectionBytes to stress boundaries
+size_t g_section_bytes = 64 << 20;           // two in flight -> 128MB peak
+
 // newline-aligned split of [0, len) into nt chunks
 std::vector<size_t> chunk_starts(const char* buf, size_t len, int nt) {
   std::vector<size_t> starts{0};
@@ -110,80 +165,99 @@ std::vector<size_t> chunk_starts(const char* buf, size_t len, int nt) {
 
 extern "C" {
 
-// First pass: count data rows and columns.  Returns 0 on success.
+// Test hook: override the pipeline section size (0 restores the default).
+void SetParserSectionBytes(int64_t n) {
+  g_section_bytes = n > 0 ? static_cast<size_t>(n) : (64 << 20);
+}
+
+// First pass: count data rows and columns, streamed through the pipelined
+// reader (no whole-file buffer).  Returns 0 on success.
 int CountDelimited(const char* path, char delim, int skip_rows,
                    int64_t* out_rows, int64_t* out_cols) {
-  std::vector<char> buf;
-  if (!read_file(path, &buf)) return 1;
-  const char* p = buf.data();
-  const char* end = p + buf.size() - 1;
+  PipelineReader reader(path, g_section_bytes);
+  if (!reader.ok()) return 1;
   int64_t rows = 0, cols = 0;
   int skipped = 0;
-  while (p < end) {
-    const char* line_end = static_cast<const char*>(
-        std::memchr(p, '\n', static_cast<size_t>(end - p)));
-    if (!line_end) line_end = end;
-    if (line_end > p) {                      // non-empty line
-      if (skipped < skip_rows) {
-        ++skipped;
-      } else {
-        if (rows == 0) {
-          cols = 1;
-          for (const char* q = p; q < line_end; ++q)
-            if (*q == delim) ++cols;
-        }
-        ++rows;
-      }
+  std::vector<char> carry;
+  const char* data;
+  size_t n;
+  auto count_line = [&](const char* p, const char* line_end) {
+    if (line_end <= p) return;               // empty line
+    if (skipped < skip_rows) {
+      ++skipped;
+      return;
     }
-    p = line_end + 1;
+    if (rows == 0) {
+      cols = 1;
+      for (const char* q = p; q < line_end; ++q)
+        if (*q == delim) ++cols;
+    }
+    ++rows;
+  };
+  while (reader.acquire(&data, &n)) {
+    const char* p = data;
+    const char* end = data + n;
+    if (!carry.empty()) {
+      // finish the line split across the section boundary
+      const char* nl = static_cast<const char*>(std::memchr(p, '\n', n));
+      size_t take = nl ? static_cast<size_t>(nl - p) : n;
+      carry.insert(carry.end(), p, p + take);
+      if (!nl) continue;                     // line still not complete
+      count_line(carry.data(), carry.data() + carry.size());
+      carry.clear();
+      p = nl + 1;
+    }
+    while (p < end) {
+      const char* nl = static_cast<const char*>(
+          std::memchr(p, '\n', static_cast<size_t>(end - p)));
+      if (!nl) {
+        carry.assign(p, end);
+        break;
+      }
+      count_line(p, nl);
+      p = nl + 1;
+    }
   }
+  if (reader.io_error()) return 1;
+  if (!carry.empty())
+    count_line(carry.data(), carry.data() + carry.size());
   *out_rows = rows;
   *out_cols = cols;
   return 0;
 }
 
-// Second pass: parse into the caller-allocated [rows, cols] matrix.
-// Thread-parallel over newline-aligned byte ranges; each thread first counts
-// the rows before its range so writes land at the right offsets.
-int ParseDelimited(const char* path, char delim, int skip_rows,
-                   int64_t rows, int64_t cols, double* out) {
-  std::vector<char> buf;
-  if (!read_file(path, &buf)) return 1;
-  const char* base = buf.data();
-  size_t len = buf.size() - 1;
+namespace {
 
-  // skip header rows
-  size_t off = 0;
-  for (int s = 0; s < skip_rows && off < len; ++s) {
-    const char* nl = static_cast<const char*>(
-        std::memchr(base + off, '\n', len - off));
-    off = nl ? static_cast<size_t>(nl - base) + 1 : len;
-  }
-
+// Parse the newline-terminated region [base, base+len) into out rows
+// starting at row_off; thread-parallel over newline-aligned chunks.
+// Returns the number of rows parsed.
+int64_t parse_region(const char* base, size_t len, char delim, int64_t rows,
+                     int64_t cols, int64_t row_off, double* out) {
+  if (len == 0) return 0;
   int nt = hardware_threads();
-  auto starts = chunk_starts(base + off, len - off, nt);
-  for (auto& s : starts) s += off;
-
-  // row index at each chunk start
+  auto starts = chunk_starts(base, len, nt);
   std::vector<int64_t> row_at(nt + 1, 0);
   for (int t = 0; t < nt; ++t) {
+    // count NON-BLANK lines only — the parse loop skips blank lines, so
+    // counting raw newlines would drift every later row's offset
     int64_t cnt = 0;
-    for (size_t p = starts[t]; p < starts[t + 1]; ++p)
-      if (base[p] == '\n') ++cnt;
-    // trailing line without newline
-    if (t == nt - 1 && starts[t + 1] > starts[t] &&
-        base[starts[t + 1] - 1] != '\n')
-      ++cnt;
+    const char* p = base + starts[t];
+    const char* cend = base + starts[t + 1];
+    while (p < cend) {
+      const char* nl = static_cast<const char*>(
+          std::memchr(p, '\n', static_cast<size_t>(cend - p)));
+      if (!nl) nl = cend;
+      if (nl > p) ++cnt;
+      p = nl + 1;
+    }
     row_at[t + 1] = row_at[t] + cnt;
   }
-
-  std::atomic<int> err{0};
   std::vector<std::thread> ths;
   for (int t = 0; t < nt; ++t) {
     ths.emplace_back([&, t]() {
       const char* p = base + starts[t];
       const char* chunk_end = base + starts[t + 1];
-      int64_t r = row_at[t];
+      int64_t r = row_off + row_at[t];
       while (p < chunk_end && r < rows) {
         const char* line_end = static_cast<const char*>(
             std::memchr(p, '\n', static_cast<size_t>(chunk_end - p)));
@@ -205,7 +279,64 @@ int ParseDelimited(const char* path, char delim, int skip_rows,
     });
   }
   for (auto& th : ths) th.join();
-  return err.load();
+  return row_at[nt];
+}
+
+}  // namespace
+
+// Second pass: parse into the caller-allocated [rows, cols] matrix.
+// Sections stream through the PipelineReader (IO overlapped with parsing);
+// within a section, parsing is thread-parallel over newline-aligned chunks.
+int ParseDelimited(const char* path, char delim, int skip_rows,
+                   int64_t rows, int64_t cols, double* out) {
+  PipelineReader reader(path, g_section_bytes);
+  if (!reader.ok()) return 1;
+  int to_skip = skip_rows;
+  int64_t row_off = 0;
+  std::vector<char> carry;                    // partial tail line
+  const char* data;
+  size_t n;
+  while (reader.acquire(&data, &n)) {
+    const char* p = data;
+    const char* end = data + n;
+    // skip header rows (may span sections)
+    while (to_skip > 0 && p < end) {
+      const char* nl = static_cast<const char*>(
+          std::memchr(p, '\n', static_cast<size_t>(end - p)));
+      if (!nl) { p = end; break; }
+      p = nl + 1;
+      --to_skip;
+    }
+    if (p >= end) continue;
+    if (!carry.empty()) {
+      const char* nl = static_cast<const char*>(
+          std::memchr(p, '\n', static_cast<size_t>(end - p)));
+      size_t take = nl ? static_cast<size_t>(nl - p) + 1
+                       : static_cast<size_t>(end - p);
+      carry.insert(carry.end(), p, p + take);
+      if (!nl) continue;                      // line still incomplete
+      row_off += parse_region(carry.data(), carry.size(), delim, rows, cols,
+                              row_off, out);
+      carry.clear();
+      p += take;
+    }
+    // parse up to the last complete line; keep the tail for the next section
+    const char* last_nl = nullptr;
+    for (const char* q = end; q > p; --q) {
+      if (q[-1] == '\n') { last_nl = q; break; }
+    }
+    if (!last_nl) {
+      carry.assign(p, end);
+      continue;
+    }
+    row_off += parse_region(p, static_cast<size_t>(last_nl - p), delim, rows,
+                            cols, row_off, out);
+    if (last_nl < end) carry.assign(last_nl, end);
+  }
+  if (reader.io_error()) return 1;
+  if (!carry.empty())
+    parse_region(carry.data(), carry.size(), delim, rows, cols, row_off, out);
+  return 0;
 }
 
 // LibSVM: "label idx:val idx:val ...".  Single pass to find dims, then

@@ -92,3 +92,50 @@ def test_dataset_uses_native_and_trains(tmp_path, binary_data):
                     train, num_boost_round=10)
     pred = bst.predict(Xte)
     assert np.mean((pred > 0.5) == (yte > 0)) > 0.8
+
+
+def test_pipeline_section_boundaries(tmp_path):
+    """Shrink the PipelineReader section so lines split across section
+    boundaries in every position; the streamed parse must still be
+    byte-identical to numpy (reference PipelineReader read-ahead,
+    include/LightGBM/utils/pipeline_reader.h)."""
+    from lightgbm_tpu import native
+    lib = native.get_lib()
+    if lib is None:
+        pytest.skip("native parser unavailable")
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 7))
+    path = tmp_path / "tiny_sections.tsv"
+    np.savetxt(path, X, delimiter="\t", fmt="%.10g", header="h1\th2",
+               comments="")
+    ref = np.genfromtxt(path, delimiter="\t", skip_header=1)
+    base = native.parse_delimited(str(path), "\t", 1)   # default sections
+    assert base is not None
+    # ~1ulp vs numpy (fast_atof rounding); byte-identical across sections
+    np.testing.assert_allclose(base, ref, rtol=1e-14, atol=0)
+    for section in (37, 113, 4096):
+        lib.SetParserSectionBytes(section)
+        try:
+            got = native.parse_delimited(str(path), "\t", 1)
+        finally:
+            lib.SetParserSectionBytes(0)
+        assert got is not None
+        np.testing.assert_array_equal(got, base, err_msg=str(section))
+
+
+def test_blank_lines_between_rows(tmp_path):
+    """Blank lines are skipped without shifting later rows' offsets."""
+    from lightgbm_tpu import native
+    lib = native.get_lib()
+    if lib is None:
+        pytest.skip("native parser unavailable")
+    path = tmp_path / "blank.csv"
+    path.write_text("1,2\n\n3,4\n\n\n5,6\n")
+    for section in (0, 4):          # default sections and 4-byte sections
+        lib.SetParserSectionBytes(section)
+        try:
+            got = native.parse_delimited(str(path), ",", 0)
+        finally:
+            lib.SetParserSectionBytes(0)
+        np.testing.assert_array_equal(
+            got, [[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], err_msg=str(section))
