@@ -461,10 +461,21 @@ class GBDT:
                 or getattr(self, "_mesh", None) is not None
                 or type(self)._bagging_weights is not GBDT._bagging_weights):
             return None
-        k = n * cfg.bagging_fraction
-        cap = int(k + max(64.0, 6.0 * float(np.sqrt(k))))
+        return self._capacity_with_margin(n * cfg.bagging_fraction, n)
+
+    @staticmethod
+    def _capacity_with_margin(expected_k: float, n: int) -> Optional[int]:
+        """Bag buffer capacity: expected count + a >6-sigma Bernoulli
+        margin, rounded up to 1024; None when it wouldn't beat full width.
+        Shared by every booster that compacts its bag (GBDT, GOSS)."""
+        cap = int(expected_k + max(64.0, 6.0 * float(np.sqrt(max(1.0, expected_k)))))
         cap = -(-cap // 1024) * 1024
         return cap if cap < n else None
+
+    def _bag_subset_refresh(self, iteration: int) -> bool:
+        """True when the bag membership changed this iteration (subclasses
+        that re-bag every iteration override)."""
+        return iteration % self.config.bagging_freq == 0
 
     @functools.cached_property
     def _bag_compact_jit(self):
@@ -625,7 +636,8 @@ class GBDT:
         cfg = self.config
         cap = self._bag_subset_capacity() if bag_mask is not None else None
         if cap is not None:
-            if it % cfg.bagging_freq == 0 or getattr(self, "_bag_sub", None) is None:
+            if (self._bag_subset_refresh(it)
+                    or getattr(self, "_bag_sub", None) is None):
                 self._bag_sub = self._bag_compact_jit(bag_mask, self._dd.bins,
                                                       cap)
             bag_rows, bag_rw, bag_bins = self._bag_sub

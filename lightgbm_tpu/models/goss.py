@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..utils.random_gen import key_for_iteration
 from .gbdt import GBDT
@@ -24,11 +25,33 @@ class GOSS(GBDT):
         # importance = sum over classes of |g*h| (goss.hpp:115)
         imp = jnp.sum(jnp.abs(grad * hess), axis=0)
         top_k = max(1, int(top_rate * n))
-        thresh = jax.lax.top_k(imp, top_k)[0][-1]
-        is_top = imp >= thresh
+        # EXACTLY top_k rows, like the reference's partial sort
+        # (``ArrayArgs::Partition`` + topN cut, goss.hpp:120-134); a
+        # ``imp >= threshold`` mask would inflate unboundedly on ties
+        # (identical |g*h| is the norm in early iterations), which both
+        # deviates from the reference and defeats the subset-capacity bound
+        _, top_idx = jax.lax.top_k(imp, top_k)
+        is_top = jnp.zeros(n, bool).at[top_idx].set(True)
         key = key_for_iteration(cfg.bagging_seed, iteration)
         sampled = (jax.random.uniform(key, (n,)) < other_rate) & ~is_top
         mask = (is_top | sampled).astype(jnp.float32)
         scale = (1.0 - top_rate) / max(other_rate, 1e-12)
         amplify = jnp.where(sampled, scale, 1.0)[None, :]
         return mask, grad * amplify, hess * amplify
+
+    # -- bagging-subset compaction (models/gbdt.py): GOSS keeps
+    # top_rate + ~other_rate of the rows and re-bags EVERY iteration, so the
+    # compacted grower pass pays one re-gather per iteration but shrinks
+    # every histogram/partition pass to O(kept rows)
+    def _bag_subset_capacity(self):
+        cfg = self.config
+        if (cfg.top_rate + cfg.other_rate >= self._BAG_SUBSET_MAX_FRACTION
+                or getattr(self, "_mesh", None) is not None):
+            return None
+        n = self.train_data.num_data
+        k_top = max(1, int(cfg.top_rate * n))
+        return self._capacity_with_margin(k_top + (n - k_top) * cfg.other_rate,
+                                          n)
+
+    def _bag_subset_refresh(self, iteration: int) -> bool:
+        return True                 # gradient-based membership: every iter

@@ -85,3 +85,29 @@ def test_subset_with_valid_and_early_stop():
                   callbacks=[lgb.record_evaluation(hist)])
     aucs = hist["valid_0"]["auc"]
     assert len(aucs) == 12 and aucs[-1] > 0.75
+
+
+def test_goss_subset_matches_masked_path():
+    """GOSS over the compacted bag buffer must produce the same trees as
+    the masked path (same exact-top-k + Bernoulli membership)."""
+    from lightgbm_tpu.models.goss import GOSS
+    X, y = _data(n=6000, f=10, seed=9)
+    params = {"objective": "binary", "boosting": "goss", "num_leaves": 15,
+              "top_rate": 0.2, "other_rate": 0.1, "verbose": -1,
+              "min_data_in_leaf": 5}
+    try:
+        GOSS._BAG_SUBSET_MAX_FRACTION = 0.8
+        b_sub = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+        assert b_sub._gbdt._bag_subset_capacity() is not None
+        GOSS._BAG_SUBSET_MAX_FRACTION = 0.0
+        b_mask = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+    finally:
+        # delattr restores inheritance from GBDT (a plain assignment would
+        # permanently shadow the base attribute on GOSS)
+        del GOSS._BAG_SUBSET_MAX_FRACTION
+    np.testing.assert_allclose(b_sub.predict(X), b_mask.predict(X),
+                               rtol=1e-5, atol=2e-6)
+    s, m = b_sub.model_to_string(), b_mask.model_to_string()
+    for tag in ("split_feature=", "threshold=", "leaf_count="):
+        assert ([l for l in s.splitlines() if l.startswith(tag)]
+                == [l for l in m.splitlines() if l.startswith(tag)]), tag
