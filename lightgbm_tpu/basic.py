@@ -168,6 +168,100 @@ class Dataset:
         self._inner.save_binary(filename)
         return self
 
+    # -- misc public surface mirroring the reference Dataset ------------
+    def get_data(self):
+        """The raw data this Dataset was built from (reference
+        ``Dataset.get_data``; None when constructed from a binary cache)."""
+        return self.data
+
+    def get_params(self) -> Dict[str, Any]:
+        return dict(self.params)
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        if categorical_feature == self.categorical_feature:
+            return self
+        if self._inner is not None:
+            if self.data is None:
+                raise LightGBMError(
+                    "Cannot set categorical feature after freed raw data; "
+                    "set free_raw_data=False when constructing the Dataset")
+            self._inner = None          # raw data held: re-bin lazily
+        self.categorical_feature = categorical_feature
+        return self
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        self.feature_name = feature_name
+        if self._inner is not None:
+            names = list(feature_name)
+            check(len(names) == self._inner.num_total_features,
+                  "Length of feature names doesn't equal with num_feature")
+            self._inner.feature_names = names
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        if self._inner is not None:
+            raise LightGBMError(
+                "Cannot set reference after the Dataset was constructed")
+        self.reference = reference
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """Set of Datasets reachable via reference links (reference
+        ``Dataset.get_ref_chain``)."""
+        head = self
+        ref_chain = set()
+        while len(ref_chain) < ref_limit:
+            if isinstance(head, Dataset):
+                ref_chain.add(head)
+                if head.reference is not None and head.reference not in ref_chain:
+                    head = head.reference
+                else:
+                    break
+            else:
+                break
+        return ref_chain
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Stack another Dataset's features onto this one column-wise
+        (reference ``Dataset.add_features_from`` / ``Dataset::AddFeaturesFrom``).
+        Both must still hold raw data (pre- or post-construct) and agree on
+        row count; the merged Dataset re-bins lazily."""
+        if (self.data is None or other.data is None
+                or isinstance(self.data, str) or isinstance(other.data, str)):
+            raise LightGBMError(
+                "Cannot add features from a Dataset without in-memory raw "
+                "data (file-backed or freed Datasets are not mergeable)")
+        a, b = self.data, other.data
+        if hasattr(a, "values"):
+            a = a.values
+        if hasattr(b, "values"):
+            b = b.values
+        check(a.shape[0] == b.shape[0], "Datasets must have equal rows")
+        width_a = a.shape[1]
+        if hasattr(a, "tocsr") or hasattr(b, "tocsr"):
+            import scipy.sparse as sps
+            merged = sps.hstack([sps.csr_matrix(a), sps.csr_matrix(b)],
+                                format="csr")
+        else:
+            merged = np.concatenate([np.asarray(a, np.float64),
+                                     np.asarray(b, np.float64)], axis=1)
+        self.data = merged
+        if (isinstance(self.feature_name, list)
+                and isinstance(other.feature_name, list)):
+            self.feature_name = list(self.feature_name) + list(other.feature_name)
+        # merge categorical designations: integer indices of ``other`` shift
+        # by this Dataset's pre-merge width; name-based entries ride the
+        # feature_name merge untouched
+        oc = other.categorical_feature
+        if oc != "auto" and oc:
+            shifted = [c + width_a if isinstance(c, (int, np.integer)) else c
+                       for c in oc]
+            mine = ([] if self.categorical_feature == "auto"
+                    else list(self.categorical_feature))
+            self.categorical_feature = mine + shifted
+        self._inner = None                  # force re-construction
+        return self
+
     def num_bins_total(self) -> int:
         self.construct()
         return int(sum(self._inner.num_bin(i) for i in range(self._inner.num_features)))
@@ -249,6 +343,109 @@ class Booster:
         self._gbdt.refit(np.asarray(data, np.float64), label, decay_rate)
         return self
 
+    # -- misc public surface mirroring the reference Booster ------------
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """Re-apply training parameters mid-run (reference
+        ``Booster.reset_parameter`` -> ``GBDT::ResetConfig``).  Compile-time
+        grower parameters (num_leaves, min_data_in_leaf, ...) force a
+        re-jit of the grow program on the next iteration."""
+        self.params.update(params)
+        gbdt = self._gbdt
+        gbdt.config.update(params)
+        gbdt.config.finalize()
+        if "learning_rate" in params:
+            gbdt.shrinkage_rate = float(gbdt.config.learning_rate)
+        if gbdt.train_data is not None:
+            old = gbdt._grower_cfg
+            # re-graft the mesh fields _setup_parallel added — rebuilding
+            # from scratch would silently turn a parallel learner serial
+            # while _mesh stays set
+            new = gbdt._make_grower_cfg()._replace(
+                axis_name=old.axis_name, parallel_mode=old.parallel_mode,
+                num_shards=old.num_shards, top_k=old.top_k)
+            if new != old:
+                # only a genuine compile-time change pays the re-jit; pure
+                # runtime params (learning_rate schedules fire every
+                # iteration) must not retrace the grower
+                gbdt._grower_cfg = new
+                gbdt.__dict__.pop("_grow_jit", None)
+        return self
+
+    def attr(self, key: str):
+        """Get a free-form attribute (reference ``Booster.attr``)."""
+        return getattr(self, "_attr", {}).get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        """Set (or with value None, delete) free-form attributes."""
+        store = getattr(self, "_attr", None)
+        if store is None:
+            store = self._attr = {}
+        for k, v in kwargs.items():
+            if v is None:
+                store.pop(k, None)
+            else:
+                store[k] = str(v)
+        return self
+
+    def lower_bound(self) -> float:
+        """Lower bound of raw prediction: sum of per-tree minimum leaf
+        values (reference ``LGBM_BoosterGetLowerBoundValue``)."""
+        return float(sum(float(np.min(t.leaf_value)) if len(t.leaf_value)
+                         else 0.0 for t in self._gbdt.models))
+
+    def upper_bound(self) -> float:
+        """Upper bound of raw prediction (reference
+        ``LGBM_BoosterGetUpperBoundValue``)."""
+        return float(sum(float(np.max(t.leaf_value)) if len(t.leaf_value)
+                         else 0.0 for t in self._gbdt.models))
+
+    def model_from_string(self, model_str: str) -> "Booster":
+        """Replace this booster's model in place (reference
+        ``Booster.model_from_string``)."""
+        self._load_from_string(model_str)
+        return self
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Shuffle tree order in [start, end) iterations (reference
+        ``Booster.shuffle_models`` -> ``GBDT::ShuffleModels``; DART
+        ensembles are order-insensitive in prediction, this reshuffles
+        which trees dropout sees first on continued training)."""
+        gbdt = self._gbdt
+        K = gbdt.num_tree_per_iteration
+        models = list(gbdt.models)
+        n_iters = len(models) // K
+        end = n_iters if end_iteration <= 0 else min(end_iteration, n_iters)
+        start = max(0, start_iteration)
+        rng = np.random.default_rng(gbdt.config.seed)
+        order = np.arange(start, end)
+        rng.shuffle(order)
+
+        def shuffle_list(lst):
+            blocks = [lst[i * K:(i + 1) * K] for i in range(n_iters)]
+            out = blocks[:start] + [blocks[i] for i in order] + blocks[end:]
+            return [t for blk in out for t in blk]
+
+        # device-side caches (TreeArrays, per-tree scales) ride the same
+        # permutation so DART's drop/normalize indexing stays aligned
+        same_len = len(gbdt._device_trees) == len(models)
+        gbdt.models = shuffle_list(models)
+        if same_len:
+            gbdt._device_trees = shuffle_list(gbdt._device_trees)
+            gbdt._tree_weights = shuffle_list(gbdt._tree_weights)
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """Name used for the training set in eval output (reference
+        ``Booster.set_train_data_name``)."""
+        self._train_data_name = name
+        self._gbdt.train_data_name = name
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """One leaf's output value (reference ``Booster.get_leaf_output``)."""
+        return float(self._gbdt.models[tree_id].leaf_value[leaf_id])
+
     # -- pickling: serialize through the model string, like the reference
     # Booster.__getstate__ (basic.py) -----------------------------------
     def __getstate__(self):
@@ -280,7 +477,8 @@ class Booster:
 
     # ------------------------------------------------------------------
     def eval_train(self, feval=None):
-        return self._eval_set("training", -1, feval)
+        return self._eval_set(
+            getattr(self, "_train_data_name", "training"), -1, feval)
 
     def eval_valid(self, feval=None):
         out = []
@@ -295,8 +493,21 @@ class Booster:
         return results
 
     def _eval_set(self, name, idx, feval):
-        all_results = self._gbdt.eval_current()
-        out = [(n, m, v, h) for (n, m, v, h) in all_results if n == name]
+        if idx < 0:
+            # explicit eval_train(): training metrics are computed on demand
+            # regardless of is_provide_training_metric (the flag only gates
+            # automatic per-iteration printing, like the reference)
+            gb = self._gbdt
+            out = []
+            if gb.train_metrics:
+                score = np.asarray(gb._train_score, np.float64)
+                s = score[0] if gb.num_tree_per_iteration == 1 else score
+                for m in gb.train_metrics:
+                    for mname, val, hib in m.eval(s, gb.objective):
+                        out.append((name, mname, val, hib))
+        else:
+            all_results = self._gbdt.eval_current()
+            out = [(n, m, v, h) for (n, m, v, h) in all_results if n == name]
         if feval is not None:
             if idx < 0:
                 score = np.asarray(self._gbdt._train_score, np.float64)

@@ -68,9 +68,10 @@ def reset_parameter(**kwargs) -> Callable:
             elif callable(value):
                 new_params[key] = value(env.iteration - env.begin_iteration)
         if new_params:
-            if "learning_rate" in new_params:
-                env.model._gbdt.shrinkage_rate = float(new_params["learning_rate"])
-                env.model._gbdt.config.learning_rate = float(new_params["learning_rate"])
+            # route through Booster.reset_parameter so compile-time grower
+            # params (num_leaves, min_data_in_leaf, ...) genuinely re-apply
+            # (reference model.reset_parameter(new_parameters))
+            env.model.reset_parameter(new_params)
             for k, v in new_params.items():
                 env.params[k] = v
     _callback.before_iteration = True

@@ -81,6 +81,7 @@ class GBDT:
         self._prev_scores = None
         self._device_trees: List = []        # per-model device TreeArrays
         self._tree_weights: List[float] = []  # current scale of each model
+        self.train_data_name = "training"    # Booster.set_train_data_name
         if train_data is not None:
             self.init_train(train_data)
 
@@ -861,7 +862,7 @@ class GBDT:
             s = score[0] if self.num_tree_per_iteration == 1 else score
             for m in self.train_metrics:
                 for name, val, hib in m.eval(s, self.objective):
-                    out.append(("training", name, val, hib))
+                    out.append((self.train_data_name, name, val, hib))
         for vi, vset in enumerate(self.valid_sets):
             score = np.asarray(self._valid_scores[vi], np.float64)
             s = score[0] if self.num_tree_per_iteration == 1 else score

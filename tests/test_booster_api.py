@@ -1,0 +1,139 @@
+"""Misc public Booster/Dataset surface mirroring the reference
+(``python-package/lightgbm/basic.py``): attributes, bounds, model
+replacement, parameter reset, shuffle, leaf access, dataset refs/merge.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def small_model(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                    lgb.Dataset(Xtr, label=ytr), num_boost_round=6)
+    return bst, Xte
+
+
+def test_attr_roundtrip(small_model):
+    bst, _ = small_model
+    assert bst.attr("missing") is None
+    bst.set_attr(run="42", note="hello")
+    assert bst.attr("run") == "42" and bst.attr("note") == "hello"
+    bst.set_attr(run=None)
+    assert bst.attr("run") is None and bst.attr("note") == "hello"
+
+
+def test_bounds_contain_predictions(small_model):
+    bst, Xte = small_model
+    raw = bst.predict(Xte, raw_score=True)
+    assert bst.lower_bound() <= raw.min() + 1e-9
+    assert bst.upper_bound() >= raw.max() - 1e-9
+    assert bst.lower_bound() < bst.upper_bound()
+
+
+def test_model_from_string_inplace(small_model, binary_data):
+    bst, Xte = small_model
+    Xtr, ytr, _, _ = binary_data
+    other = lgb.train({"objective": "binary", "num_leaves": 15,
+                       "verbose": -1},
+                      lgb.Dataset(Xtr, label=ytr), num_boost_round=2)
+    clone = lgb.Booster(model_str=other.model_to_string())
+    clone.model_from_string(bst.model_to_string())
+    np.testing.assert_allclose(clone.predict(Xte), bst.predict(Xte),
+                               rtol=1e-6)
+
+
+def test_get_leaf_output(small_model):
+    bst, _ = small_model
+    dumped = bst.dump_model()["tree_info"][0]["tree_structure"]
+
+    def first_leaf(node):
+        while "leaf_value" not in node:
+            node = node["left_child"]
+        return node
+    leaf = first_leaf(dumped)
+    got = bst.get_leaf_output(0, leaf["leaf_index"])
+    assert got == pytest.approx(leaf["leaf_value"], rel=1e-9)
+
+
+def test_reset_parameter_applies_structure(binary_data):
+    """num_leaves reset mid-training genuinely changes later trees."""
+    Xtr, ytr, _, _ = binary_data
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 31,
+                              "min_data_in_leaf": 5, "verbose": -1},
+                      train_set=lgb.Dataset(Xtr, label=ytr))
+    for _ in range(2):
+        bst.update()
+    bst.reset_parameter({"num_leaves": 4})
+    for _ in range(2):
+        bst.update()
+    counts = [t["num_leaves"] for t in bst.dump_model()["tree_info"]]
+    assert counts[0] > 4 and counts[-1] <= 4, counts
+
+
+def test_reset_parameter_callback_num_leaves(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 5,
+         "verbose": -1},
+        lgb.Dataset(Xtr, label=ytr), num_boost_round=4,
+        callbacks=[lgb.reset_parameter(
+            num_leaves=lambda it: 31 if it < 2 else 4)])
+    counts = [t["num_leaves"] for t in bst.dump_model()["tree_info"]]
+    assert counts[0] > 4 and counts[-1] <= 4, counts
+
+
+def test_shuffle_models_preserves_prediction(small_model, binary_data):
+    Xtr, ytr, Xte, _ = binary_data
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                    lgb.Dataset(Xtr, label=ytr), num_boost_round=6)
+    before = bst.predict(Xte)
+    order_before = bst.model_to_string()
+    bst.shuffle_models()
+    # additive ensemble: prediction invariant under tree order
+    np.testing.assert_allclose(bst.predict(Xte), before, rtol=1e-6)
+    assert bst.num_trees() == 6
+    assert bst.model_to_string() != order_before    # order DID change
+
+
+def test_dataset_ref_chain_and_setters(binary_data):
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    valid = lgb.Dataset(Xte, label=yte)
+    valid.set_reference(train)
+    chain = valid.get_ref_chain()
+    assert train in chain and valid in chain
+    train.set_feature_name([f"f{i}" for i in range(Xtr.shape[1])])
+    train.construct()
+    assert train.get_feature_name()[0] == "f0"
+    assert train.get_params() == {}
+    assert train.get_data() is Xtr
+    with pytest.raises(lgb.LightGBMError):
+        valid.construct() and valid.set_reference(train)
+
+
+def test_add_features_from(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    left = lgb.Dataset(Xtr[:, :4], label=ytr)
+    right = lgb.Dataset(Xtr[:, 4:], categorical_feature=[1])
+    left.add_features_from(right)
+    left.construct()
+    assert left.num_feature() == Xtr.shape[1]
+    # other's categorical index shifted by left's width
+    assert left.categorical_feature == [5]
+    with pytest.raises(lgb.LightGBMError):
+        lgb.Dataset("some_file.csv").add_features_from(right)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                    left, num_boost_round=3)
+    assert bst.num_trees() == 3
+
+
+def test_set_train_data_name(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    bst = lgb.train({"objective": "binary", "metric": "auc", "verbose": -1},
+                    lgb.Dataset(Xtr, label=ytr), num_boost_round=1)
+    bst.set_train_data_name("my_training")
+    names = [r[0] for r in bst.eval_train()]
+    assert names and all(n == "my_training" for n in names)
