@@ -349,6 +349,23 @@ class Booster:
         ``Booster.reset_parameter`` -> ``GBDT::ResetConfig``).  Compile-time
         grower parameters (num_leaves, min_data_in_leaf, ...) force a
         re-jit of the grow program on the next iteration."""
+        # dataset-level parameters are baked into the binned matrix — a
+        # change here could not take effect (or worse: a smaller max_bin
+        # would shrink the histogram under already-binned indices).  The
+        # reference's ResetConfig rejects these the same way.
+        _DATASET_PARAMS = {
+            "max_bin", "max_bin_by_feature", "min_data_in_bin",
+            "bin_construct_sample_cnt", "data_random_seed", "use_missing",
+            "zero_as_missing", "feature_pre_filter", "enable_bundle",
+            "categorical_feature", "linear_tree", "pre_partition",
+        }
+        cfgcls = Config
+        bad = sorted(_DATASET_PARAMS
+                     & {cfgcls.resolve_alias(str(k)) for k in params})
+        if bad and self._gbdt.train_data is not None:
+            raise LightGBMError(
+                "Cannot change dataset parameters %s after the Dataset was "
+                "constructed; rebuild the Dataset instead" % bad)
         self.params.update(params)
         gbdt = self._gbdt
         gbdt.config.update(params)
@@ -417,6 +434,9 @@ class Booster:
         n_iters = len(models) // K
         end = n_iters if end_iteration <= 0 else min(end_iteration, n_iters)
         start = max(0, start_iteration)
+        if start >= end:
+            raise LightGBMError(
+                f"shuffle_models: empty range [{start}, {end})")
         rng = np.random.default_rng(gbdt.config.seed)
         order = np.arange(start, end)
         rng.shuffle(order)
@@ -499,7 +519,8 @@ class Booster:
             # automatic per-iteration printing, like the reference)
             gb = self._gbdt
             out = []
-            if gb.train_metrics:
+            # boosters loaded from model text have no training data/metrics
+            if getattr(gb, "train_metrics", None) and gb._train_score is not None:
                 score = np.asarray(gb._train_score, np.float64)
                 s = score[0] if gb.num_tree_per_iteration == 1 else score
                 for m in gb.train_metrics:

@@ -18,11 +18,12 @@ dask.py``) is ``jax.distributed.initialize`` + the standard TPU pod runtime.
 """
 from .mesh import default_mesh, init_distributed
 from ..io.distributed import distributed_dataset
+from .trainer import train_distributed
 from .data_parallel import make_dp_train_step, pad_rows_to_multiple, shard_rows
 from .feature_parallel import make_fp_train_step, pad_features_to_multiple
 from .voting_parallel import make_voting_train_step
 
-__all__ = ["default_mesh", "init_distributed", "distributed_dataset",
+__all__ = ["default_mesh", "init_distributed", "distributed_dataset", "train_distributed",
            "make_dp_train_step",
            "make_fp_train_step", "make_voting_train_step",
            "pad_rows_to_multiple", "pad_features_to_multiple", "shard_rows"]

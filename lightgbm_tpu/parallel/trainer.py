@@ -1,0 +1,158 @@
+"""Multi-process end-to-end training: the Dask-package analog.
+
+Reference analog: ``python-package/lightgbm/dask.py`` — each worker holds a
+partition, `LGBM_NetworkInit` wires the ranks, and every rank runs the same
+training loop with collective histogram merges, producing identical models.
+
+Here the ranks are ``jax.distributed`` processes: ingest is
+``io.distributed.distributed_dataset`` (pooled-sample binning → identical
+mappers), the per-iteration step is ``make_dp_train_step``'s shard_map
+program whose psum/pmax collectives cross process boundaries over the
+global device mesh, and every process assembles the identical model from
+the replicated tree output.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..config import Config
+from ..io.distributed import distributed_dataset
+from ..utils.log import Log, check
+from ..utils.random_gen import key_for_iteration
+from .data_parallel import make_dp_train_step
+from .mesh import DATA_AXIS
+
+
+def train_distributed(params, data, label, num_boost_round: Optional[int] = None,
+                      feature_name=None, categorical_feature=None):
+    """Train over every ``jax.distributed`` process's local partition and
+    return a ``Booster`` (identical on every process).
+
+    ``data``/``label`` are THIS process's rows.  Requires
+    ``parallel.mesh.init_distributed`` to have run.  Single-process calls
+    degrade to the ordinary engine.  v1 scope: one model per iteration
+    objectives with mean-based boost_from_average (regression l2, binary);
+    sample weights and valid sets are not yet wired through the
+    multi-process loop.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    cfg = Config.from_params(dict(params or {}))
+    rounds = (num_boost_round if num_boost_round is not None
+              else cfg.num_iterations)
+    if jax.process_count() > 1:
+        # v1: the shard_map step runs bins as plain per-feature columns
+        cfg.enable_bundle = False
+
+    ds = distributed_dataset(data, cfg, label=label,
+                             categorical_feature=categorical_feature,
+                             feature_names=feature_name)
+    if jax.process_count() == 1:
+        from ..basic import Booster, Dataset
+        wrapper = Dataset(None, params=dict(params or {}))
+        wrapper._inner = ds
+        from ..engine import train as _train
+        return _train(dict(params or {}), wrapper, num_boost_round=rounds)
+
+    from jax.experimental import multihost_utils as mhu
+    from ..objective import create_objective
+    from ..models.gbdt import GBDT
+    from ..models.tree import Tree
+
+    check(cfg.num_class <= 1 or cfg.objective in ("regression", "binary"),
+          "train_distributed v1 supports single-model-per-iteration "
+          "objectives")
+    objective = create_objective(cfg)
+    check(objective is not None and objective.num_model_per_iteration == 1,
+          "train_distributed v1 supports one tree per iteration")
+
+    # --- equal per-process row blocks (pad rows ride weight 0) ----------
+    n_local = ds.num_data
+    d_local = jax.local_device_count()
+    per_proc = int(np.asarray(mhu.process_allgather(np.int64(n_local))).max())
+    per_proc = -(-per_proc // d_local) * d_local
+    pad = per_proc - n_local
+    bins_l = np.pad(np.asarray(ds.bins), ((0, pad), (0, 0)))
+    label_np = np.asarray(ds.metadata.label, np.float32)
+    label_l = np.pad(label_np, (0, pad))
+    rw_l = np.pad(np.ones(n_local, np.float32), (0, pad))
+    N = per_proc * jax.process_count()
+
+    mesh = Mesh(np.array(jax.devices()), (DATA_AXIS,))
+    sh = NamedSharding(mesh, P(DATA_AXIS))
+    mk = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
+        sh, a, (N,) + a.shape[1:])
+    bins_g, label_g, rw_g = mk(bins_l), mk(label_l), mk(rw_l)
+
+    # --- GLOBAL boost-from-average: only the weighted label mean crosses
+    # processes (two scalars), then the objective's own formula applies.
+    # A per-process mean would give each rank a different init score.
+    init = 0.0
+    if cfg.boost_from_average:
+        sums = np.asarray(mhu.process_allgather(
+            np.asarray([float(label_np.sum()), float(n_local)])))
+        wl, w = float(sums[:, 0].sum()), float(sums[:, 1].sum())
+        from ..io.dataset import Metadata
+        surrogate = Metadata(2)
+        surrogate.set_field("label", np.asarray([0.0, 1.0]))
+        surrogate.set_field("weight", np.asarray([max(w - wl, 1e-12),
+                                                  max(wl, 1e-12)]))
+        obj2 = create_objective(cfg)
+        obj2.init(surrogate, 2)
+        if cfg.objective in ("regression", "binary"):
+            init = obj2.boost_from_score(0)
+        else:
+            Log.warning("train_distributed: boost_from_average for "
+                        "objective %s is not pooled globally; starting "
+                        "from 0", cfg.objective)
+
+    objective.init(ds.metadata, n_local)     # local stats for gradients
+
+    dd = ds.device_data()
+    tmp = GBDT(cfg)
+    tmp.train_data = ds
+    tmp._dd = dd
+    gcfg = tmp._make_grower_cfg()._replace(
+        num_shards=jax.device_count(), parallel_mode="data")
+    meta = dict(num_bins=dd.num_bins, default_bins=dd.default_bins,
+                nan_bins=dd.nan_bins, is_categorical=dd.is_categorical,
+                monotone=dd.monotone)
+
+    def grad_fn(score, lab):
+        return objective.get_gradients(score, lab, None)
+
+    step = make_dp_train_step(gcfg, meta, grad_fn, cfg.learning_rate, mesh)
+    fmask = jnp.ones(ds.num_features, jnp.float32)
+    score = jax.make_array_from_process_local_data(
+        sh, np.full((per_proc,), init, np.float32), (N,))
+
+    trees = []
+    for it in range(rounds):
+        key = key_for_iteration(cfg.seed, it, salt=1)
+        score, tree_arrays = step(bins_g, label_g, score, rw_g, fmask, key)
+        host = jax.device_get(tree_arrays)
+        t = Tree.from_arrays(host, ds, learning_rate=1.0)
+        t.shrink(cfg.learning_rate)
+        if it == 0 and init != 0.0:
+            if int(host.num_leaves) > 1:
+                t.add_bias(init)
+            else:
+                t.leaf_value = np.full_like(t.leaf_value, init)
+        trees.append(t)
+
+    # --- identical Booster on every process -----------------------------
+    gbdt = GBDT(cfg)
+    gbdt.train_data = ds
+    gbdt.objective = objective
+    gbdt.models = trees
+    gbdt.init_scores = [init]
+    gbdt.num_tree_per_iteration = 1
+    gbdt.max_feature_idx = ds.num_total_features - 1
+    gbdt.iter_ = rounds
+    from ..models import model_io
+    from ..basic import Booster
+    return Booster(model_str=model_io.save_model_to_string(gbdt))
