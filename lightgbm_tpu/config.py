@@ -397,16 +397,29 @@ class Config:
                      "refit_tree": "refit"}.get(self.task.lower(), self.task.lower())
 
         self.monotone_constraints_method = self.monotone_constraints_method.lower()
+
+        # (force_col_wise/force_row_wise conflict is checked below with the
+        # other CheckParamConflict analogs)
+        if self.histogram_pool_size >= 0:
+            Log.info("histogram_pool_size is ignored: the dense device "
+                     "histogram store has no LRU pool (HBM is the pool)")
         check(self.monotone_constraints_method in ("basic", "intermediate", "advanced"),
               f"unknown monotone_constraints_method: {self.monotone_constraints_method}")
         if self.monotone_constraints_method == "advanced" and self.monotone_constraints:
-            # intermediate bounds are a superset of advanced's guarantees
-            # (monotone_constraints.hpp AdvancedLeafConstraints adds
-            # per-threshold cumulative slack on top), so falling back
-            # preserves monotonicity, only losing some split quality
-            Log.warning("monotone_constraints_method=advanced is not "
-                        "implemented yet; falling back to 'intermediate' "
-                        "(constraints still enforced)")
+            # 'advanced' runs the intermediate machinery: bounds come from
+            # exact per-leaf rectangle comparability (ops/grower.py
+            # rect_lo/rect_hi) instead of the reference's per-threshold
+            # segments (monotone_constraints.hpp AdvancedLeafConstraints).
+            # Along the monotone dim itself the two coincide (leaves
+            # overlapping in all other dims are strictly ordered there),
+            # but a child created by splitting on ANOTHER feature can shed
+            # comparable neighbors that the inherited whole-leaf bound
+            # still reflects — so like the reference's intermediate-vs-
+            # advanced gap, some splits may be over-constrained.
+            # Monotonicity itself is always preserved.
+            Log.warning("monotone_constraints_method=advanced runs the "
+                        "intermediate (rect-bound) machinery; constraints "
+                        "are enforced but may over-tighten some splits")
             self.monotone_constraints_method = "intermediate"
         check(self.boosting in BOOSTING_TYPES, f"unknown boosting type: {self.boosting}")
         check(self.tree_learner in TREE_LEARNER_TYPES, f"unknown tree learner: {self.tree_learner}")

@@ -221,11 +221,30 @@ class GBDT:
             ds.bin_mappers[r].bin_type == BinType.CATEGORICAL
             and ds.num_bin(i) > cfg.max_cat_to_onehot
             for i, r in enumerate(ds.used_features))
+        # histogram layout: auto-picked by backend (the analog of the
+        # reference's TrainingShareStates timed row/col-wise autotune,
+        # train_share_states.h — here the winner per backend is known:
+        # pallas one-hot on TPU, scatter-add on CPU, so the pick is static
+        # and the first-iteration timing run is saved); force_col_wise/
+        # force_row_wise override it like the reference's flags
+        # (col-wise = per-column scatter adds, row-wise = each row pushed
+        # into all feature histograms at once = the one-hot matmul)
+        if cfg.force_col_wise:
+            hist_method = "scatter"
+        elif cfg.force_row_wise:
+            hist_method = ("pallas" if jax.default_backend() == "tpu"
+                           else "onehot")
+        else:
+            hist_method = {"tpu": "pallas", "cpu": "scatter"}.get(
+                jax.default_backend(), "onehot")
+        if cfg.force_col_wise and jax.default_backend() == "tpu":
+            Log.warning("force_col_wise maps to the scatter histogram "
+                        "kernel, which is much slower than the default "
+                        "one-hot MXU kernel on TPU")
         return GrowerConfig(
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth, max_bin=max_bin,
             split=sp, feature_fraction_bynode=cfg.feature_fraction_bynode,
-            hist_method={"tpu": "pallas", "cpu": "scatter"}.get(
-                jax.default_backend(), "onehot"),
+            hist_method=hist_method,
             hist_chunk_rows=cfg.hist_chunk_rows,
             cegb_split_penalty=cfg.cegb_tradeoff * cfg.cegb_penalty_split,
             hist_compact=cfg.hist_compact,

@@ -69,6 +69,17 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
     objective = create_objective(cfg)
     check(objective is not None and objective.num_model_per_iteration == 1,
           "train_distributed v1 supports one tree per iteration")
+    # reject configs the fixed-ones row/feature masks would silently ignore
+    # (the per-iteration sampling machinery lives in the full GBDT loop)
+    check(cfg.bagging_freq == 0 or cfg.bagging_fraction >= 1.0,
+          "train_distributed v1 does not support bagging")
+    check(cfg.feature_fraction >= 1.0 and cfg.feature_fraction_bynode >= 1.0,
+          "train_distributed v1 does not support feature_fraction")
+    check(cfg.boosting == "gbdt",
+          "train_distributed v1 supports boosting=gbdt only")
+    check(not cfg.is_unbalance and cfg.scale_pos_weight == 1.0,
+          "train_distributed v1 does not support is_unbalance/"
+          "scale_pos_weight (class stats would be per-shard, not global)")
 
     # --- equal per-process row blocks (pad rows ride weight 0) ----------
     n_local = ds.num_data
@@ -88,22 +99,28 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
         sh, a, (N,) + a.shape[1:])
     bins_g, label_g, rw_g = mk(bins_l), mk(label_l), mk(rw_l)
 
-    # --- GLOBAL boost-from-average: only the weighted label mean crosses
-    # processes (two scalars), then the objective's own formula applies.
-    # A per-process mean would give each rank a different init score.
+    # --- GLOBAL boost-from-average: only the weighted label sum/count
+    # crosses processes (two scalars), then the objective's own formula
+    # applies.  A per-process mean would give each rank a different init.
     init = 0.0
     if cfg.boost_from_average:
         sums = np.asarray(mhu.process_allgather(
             np.asarray([float(label_np.sum()), float(n_local)])))
         wl, w = float(sums[:, 0].sum()), float(sums[:, 1].sum())
-        from ..io.dataset import Metadata
-        surrogate = Metadata(2)
-        surrogate.set_field("label", np.asarray([0.0, 1.0]))
-        surrogate.set_field("weight", np.asarray([max(w - wl, 1e-12),
-                                                  max(wl, 1e-12)]))
-        obj2 = create_objective(cfg)
-        obj2.init(surrogate, 2)
-        if cfg.objective in ("regression", "binary"):
+        if cfg.objective == "regression":
+            init = wl / max(w, 1.0)          # pooled mean (RegressionL2)
+        elif cfg.objective == "binary":
+            # binary labels are 0/1, so a two-point weighted surrogate
+            # reproduces the pooled pavg exactly and reuses the
+            # objective's own initscore formula (sigmoid scaling etc.)
+            from ..io.dataset import Metadata
+            surrogate = Metadata(2)
+            surrogate.set_field("label", np.asarray([0.0, 1.0]))
+            surrogate.set_field("weight",
+                                np.asarray([max(w - wl, 1e-12),
+                                            max(wl, 1e-12)]))
+            obj2 = create_objective(cfg)
+            obj2.init(surrogate, 2)
             init = obj2.boost_from_score(0)
         else:
             Log.warning("train_distributed: boost_from_average for "

@@ -907,3 +907,22 @@ def test_weight_equals_row_duplication(regression_data):
     # larger sample, so compare predictions loosely)
     c = np.corrcoef(b_w.predict(X), b_d.predict(X))[0, 1]
     assert c > 0.98
+
+
+def test_force_col_row_wise(binary_data):
+    """force_col_wise / force_row_wise pick the histogram kernel and train
+    to the same model (reference CheckParamConflict + layout flags)."""
+    Xtr, ytr, _, _ = binary_data
+    preds = []
+    for extra in ({}, {"force_col_wise": True}, {"force_row_wise": True}):
+        params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+        params.update(extra)
+        bst = lgb.train(params, lgb.Dataset(Xtr, label=ytr),
+                        num_boost_round=3)
+        preds.append(bst.predict(Xtr))
+    np.testing.assert_allclose(preds[1], preds[0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(preds[2], preds[0], rtol=1e-5, atol=1e-6)
+    with pytest.raises(Exception, match="force_col_wise and force_row_wise"):
+        lgb.train({"objective": "binary", "force_col_wise": True,
+                   "force_row_wise": True, "verbose": -1},
+                  lgb.Dataset(Xtr, label=ytr), num_boost_round=1)
