@@ -405,22 +405,12 @@ class Config:
                      "histogram store has no LRU pool (HBM is the pool)")
         check(self.monotone_constraints_method in ("basic", "intermediate", "advanced"),
               f"unknown monotone_constraints_method: {self.monotone_constraints_method}")
-        if self.monotone_constraints_method == "advanced" and self.monotone_constraints:
-            # 'advanced' runs the intermediate machinery: bounds come from
-            # exact per-leaf rectangle comparability (ops/grower.py
-            # rect_lo/rect_hi) instead of the reference's per-threshold
-            # segments (monotone_constraints.hpp AdvancedLeafConstraints).
-            # Along the monotone dim itself the two coincide (leaves
-            # overlapping in all other dims are strictly ordered there),
-            # but a child created by splitting on ANOTHER feature can shed
-            # comparable neighbors that the inherited whole-leaf bound
-            # still reflects — so like the reference's intermediate-vs-
-            # advanced gap, some splits may be over-constrained.
-            # Monotonicity itself is always preserved.
-            Log.warning("monotone_constraints_method=advanced runs the "
-                        "intermediate (rect-bound) machinery; constraints "
-                        "are enforced but may over-tighten some splits")
-            self.monotone_constraints_method = "intermediate"
+        # 'advanced' extends the intermediate rect machinery: each new
+        # child's bounds are re-derived from current rectangle
+        # comparability over all active leaves (ops/grower.py apply_split
+        # mono_adv), the TPU-design analog of the reference's
+        # per-threshold AdvancedLeafConstraints
+        # (monotone_constraints.hpp:230-375).
         check(self.boosting in BOOSTING_TYPES, f"unknown boosting type: {self.boosting}")
         check(self.tree_learner in TREE_LEARNER_TYPES, f"unknown tree learner: {self.tree_learner}")
         check(self.device_type in DEVICE_TYPES, f"unknown device type: {self.device_type}")

@@ -70,6 +70,30 @@ def _reduce_split_global(s: SplitResult, axis_name: str) -> SplitResult:
         cat_bits=bc(s.cat_bits))
 
 
+def _rect_comparability(rect_lo, rect_hi, c_lo_row, c_hi_row, mono_f):
+    """Monotone comparability masks of every leaf rect vs one child rect.
+
+    Two leaves are comparable along monotone dim k when their rects overlap
+    in every other dim and are strictly ordered along k (in an axis-aligned
+    partition, all-but-k overlap implies strict k-ordering).  Returns
+    ``(upper, lower)`` ``[L, F]`` masks: ``upper[m, k]`` — leaf m sits on
+    the child's greater side along k (so ``out_child <= out_m``),
+    ``lower`` mirrored."""
+    ovl_d = ((rect_lo <= c_hi_row[None, :])
+             & (rect_hi >= c_lo_row[None, :]))               # [L, F]
+    miss_cnt = jnp.sum(~ovl_d, axis=1)                       # [L]
+    # overlap in all dims except k: no misses, or the only miss is k itself
+    ovl_exc = ((miss_cnt == 0)[:, None]
+               | ((miss_cnt == 1)[:, None] & ~ovl_d))        # [L, F]
+    m_right = rect_lo > c_hi_row[None, :]                    # [L, F]
+    m_left = rect_hi < c_lo_row[None, :]
+    upper = ovl_exc & (((mono_f > 0)[None, :] & m_right)
+                       | ((mono_f < 0)[None, :] & m_left))
+    lower = ovl_exc & (((mono_f > 0)[None, :] & m_left)
+                       | ((mono_f < 0)[None, :] & m_right))
+    return upper, lower
+
+
 class GrowerConfig(NamedTuple):
     """Static (compile-time) grower parameters."""
     num_leaves: int
@@ -589,7 +613,17 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # go stale when bounds tighten, so the growth loop re-validates the
     # chosen leaf's split against current bounds before applying (the
     # analog of RecomputeBestSplitForLeaf, serial_tree_learner.cpp:673-681).
-    mono_inter = cfg.has_monotone and cfg.monotone_mode == "intermediate"
+    # intermediate AND advanced share the rect-tracking machinery; advanced
+    # additionally RE-DERIVES each new child's output bounds from current
+    # rect comparability over all active leaves (see apply_split), instead
+    # of inheriting the parent's pinched scalars — the analog of the
+    # reference's AdvancedLeafConstraints precision
+    # (monotone_constraints.hpp:230-375): a child created by a split on a
+    # NON-monotone feature can shed comparable neighbors, and the inherited
+    # whole-parent bound would over-tighten it.
+    mono_inter = cfg.has_monotone and cfg.monotone_mode in ("intermediate",
+                                                            "advanced")
+    mono_adv = cfg.has_monotone and cfg.monotone_mode == "advanced"
 
     use_cegb = (cegb_coupled is not None or cegb_lazy is not None
                 or cfg.cegb_split_penalty > 0.0)
@@ -711,6 +745,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # leaf's cached best split was searched under: the re-validation
         # must re-key with the SAME step, not resample
         state["leaf_step"] = jnp.zeros(L, jnp.int32)
+    if mono_adv:
+        # current output of every active leaf (advanced bound derivation);
+        # root output from the unconstrained totals
+        root_out = leaf_output(state["leaf_sum_g"][0], state["leaf_weight"][0],
+                               p, 0.0, state["leaf_count"][0])
+        state["leaf_out"] = jnp.zeros(L, jnp.float32).at[0].set(root_out)
     if interaction_sets is not None:
         state["leaf_branch"] = jnp.zeros((L, f_full), jnp.float32)
     if cegb_coupled is not None:
@@ -922,6 +962,37 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             rect_hi = setw(setw(st["rect_hi"], leaf, l_rh), new_id, prh)
             extra_mono = dict(rect_lo=rect_lo, rect_hi=rect_hi)
 
+            if mono_adv:
+                # ADVANCED: re-derive each child's bounds from current rect
+                # comparability over all active leaves, instead of the
+                # inherited parent scalars — a child of a split on a
+                # non-monotone feature sheds comparable neighbors, and the
+                # inherited bound would keep constraining it by them
+                # (reference AdvancedLeafConstraints precision).
+                new_out = setw(setw(st["leaf_out"], leaf, lo_out),
+                               new_id, ro_out)
+                lid = jnp.arange(L, dtype=jnp.int32)
+                act = lid <= st["num_leaves"]        # old leaves + new slot
+                mono_f = monotone.astype(jnp.int32)
+
+                def derive(c_lo_row, c_hi_row, self_id):
+                    upper, lower = _rect_comparability(
+                        rect_lo, rect_hi, c_lo_row, c_hi_row, mono_f)
+                    elig = (act & (lid != self_id))[:, None]
+                    hi_c = jnp.min(jnp.where(upper & elig,
+                                             new_out[:, None], -NEG_INF))
+                    lo_c = jnp.max(jnp.where(lower & elig,
+                                             new_out[:, None], NEG_INF))
+                    return lo_c, hi_c
+
+                al_lo, al_hi = derive(prl, l_rh, leaf)
+                ar_lo, ar_hi = derive(r_rl, prh, new_id)
+                leaf_lo = setw(setw(st["leaf_lo"], leaf, al_lo),
+                               new_id, ar_lo)
+                leaf_hi = setw(setw(st["leaf_hi"], leaf, al_hi),
+                               new_id, ar_hi)
+                extra_mono["leaf_out"] = new_out
+
             # Propagate the new child outputs to every active leaf that
             # overlaps a child in all dims except SOME monotone dim k and
             # sits strictly to one side of it along k — for ANY monotone k,
@@ -934,24 +1005,18 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             mono_f = monotone.astype(jnp.int32)                  # [F]
 
             def prop(llo, lhi, c_lo_row, c_hi_row, out_c):
-                ovl_d = ((rect_lo <= c_hi_row[None, :])
-                         & (rect_hi >= c_lo_row[None, :]))       # [L, F]
-                miss_cnt = jnp.sum(~ovl_d, axis=1)               # [L]
-                # overlap in all dims except k: no misses, or the only miss
-                # is dim k itself
-                ovl_exc = ((miss_cnt == 0)[:, None]
-                           | ((miss_cnt == 1)[:, None] & ~ovl_d))  # [L, F]
-                m_right = rect_lo > c_hi_row[None, :]            # [L, F]
-                m_left = rect_hi < c_lo_row[None, :]
-                raise_lo = jnp.any(
-                    ovl_exc & ((mono_f > 0)[None, :] & m_right
-                               | (mono_f < 0)[None, :] & m_left), axis=1)
-                drop_hi = jnp.any(
-                    ovl_exc & ((mono_f > 0)[None, :] & m_left
-                               | (mono_f < 0)[None, :] & m_right), axis=1)
-                llo = jnp.where(do_prop & is_active & raise_lo,
+                # upper[m]: m sits on the child's GREATER side (it bounds
+                # the child's hi) — symmetrically the child's output is a
+                # LOWER bound on m.  lower[m] mirrors.  prop updates the
+                # NEIGHBORS; derive() uses the same masks to update the
+                # child itself.
+                upper, lower = _rect_comparability(
+                    rect_lo, rect_hi, c_lo_row, c_hi_row, mono_f)
+                in_upper = jnp.any(upper, axis=1)
+                in_lower = jnp.any(lower, axis=1)
+                llo = jnp.where(do_prop & is_active & in_upper,
                                 jnp.maximum(llo, out_c), llo)
-                lhi = jnp.where(do_prop & is_active & drop_hi,
+                lhi = jnp.where(do_prop & is_active & in_lower,
                                 jnp.minimum(lhi, out_c), lhi)
                 return llo, lhi
 
@@ -1015,8 +1080,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         g2 = jnp.stack([b.lg[leaf], b.rg[leaf]])
         h2 = jnp.stack([b.lh[leaf], b.rh[leaf]])
         c2 = jnp.stack([b.lc[leaf], b.rc[leaf]])
-        lo2 = jnp.stack([l_lo, r_lo])
-        hi2 = jnp.stack([l_hi, r_hi])
+        # search under the FINAL stored bounds: advanced re-derivation and
+        # cross-leaf propagation may have moved them past the inherited
+        # pinch (cached gains computed under stale-tighter bounds would
+        # silently lose exactly the splits advanced mode admits)
+        lo2 = jnp.stack([leaf_lo[leaf], leaf_lo[new_id]])
+        hi2 = jnp.stack([leaf_hi[leaf], leaf_hi[new_id]])
         if use_cegb:
             pen2 = jnp.stack([cegb_penalty(lmask, c2[0], feat_used, used_data),
                               cegb_penalty(rmask, c2[1], feat_used, used_data)])

@@ -71,7 +71,9 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
           "train_distributed v1 supports one tree per iteration")
     # reject configs the fixed-ones row/feature masks would silently ignore
     # (the per-iteration sampling machinery lives in the full GBDT loop)
-    check(cfg.bagging_freq == 0 or cfg.bagging_fraction >= 1.0,
+    check(cfg.bagging_freq == 0 or (cfg.bagging_fraction >= 1.0
+                                    and cfg.pos_bagging_fraction >= 1.0
+                                    and cfg.neg_bagging_fraction >= 1.0),
           "train_distributed v1 does not support bagging")
     check(cfg.feature_fraction >= 1.0 and cfg.feature_fraction_bynode >= 1.0,
           "train_distributed v1 does not support feature_fraction")
