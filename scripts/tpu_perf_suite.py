@@ -1,0 +1,140 @@
+"""One-shot TPU perf diagnosis: sanity → kernel micro → headline bench.
+
+The axon tunnel can wedge for hours (see README round-3 notes); when a
+recovery window appears, this packs the whole perf story into ONE process
+so nothing is wasted: (1) device sanity, (2) Pallas-vs-onehot histogram
+microbench at the bench shape, (3) grow_tree isolation, (4) the headline
+bench. Results append to ``perf_results.jsonl`` as they land, so a
+mid-run re-wedge still leaves everything completed so far on disk.
+
+Run (ONLY process touching the TPU):
+    python scripts/tpu_perf_suite.py [rows]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "perf_results.jsonl")
+ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+
+
+def emit(**kv):
+    kv["ts"] = time.time()
+    with open(OUT, "a") as f:
+        f.write(json.dumps(kv) + "\n")
+    print(json.dumps(kv), flush=True)
+
+
+def main():
+    # wedge-safe: prove the backend live in a TIMEOUT-GUARDED subprocess
+    # before this process commits to it (a wedged tunnel hangs forever)
+    import subprocess
+    if "axon" in os.environ.get("JAX_PLATFORMS", "axon") \
+            and not os.environ.get("_SUITE_PROBED"):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp;"
+                 "(jnp.ones((64,64)) @ jnp.ones((64,64))).block_until_ready();"
+                 "print('live')"],
+                timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", 300)),
+                capture_output=True, text=True)
+            live = "live" in (r.stdout or "")
+        except subprocess.TimeoutExpired:
+            live = False
+        if not live:
+            emit(stage="abort", reason="tpu_unreachable")
+            return 1
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    t0 = time.perf_counter()
+    x = jnp.ones((512, 512))
+    (x @ x).block_until_ready()
+    emit(stage="sanity", backend=jax.default_backend(),
+         secs=round(time.perf_counter() - t0, 2))
+
+    # --- histogram kernels at the bench shape ---------------------------
+    from lightgbm_tpu.ops.histogram import _hist_onehot, _hist_pallas
+    rng = np.random.default_rng(0)
+    N, F, B = ROWS, 28, 255
+    bins = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.asarray(np.full(N, 0.25, np.float32))
+    m = jnp.ones(N, jnp.float32)
+
+    def timed(fn, iters=10):
+        jfn = jax.jit(lambda b_, g_: jnp.sum(fn(b_, g_, h, m, B)))
+        float(jfn(bins, g))
+        t = time.perf_counter()
+        for _ in range(iters):
+            float(jfn(bins, g + 1e-12))
+        return (time.perf_counter() - t) / iters
+
+    if jax.default_backend() == "tpu":
+        try:
+            t_pallas = timed(_hist_pallas)
+            emit(stage="hist_pallas", ms=round(t_pallas * 1e3, 3),
+                 grows_per_sec=round(N / t_pallas / 1e9, 3))
+        except Exception as e:        # lowering failure must be visible
+            emit(stage="hist_pallas", error=str(e)[:300])
+    t_onehot = timed(lambda b_, g_, h_, m_, B_: _hist_onehot(
+        b_, g_, h_, m_, B_, 65536))
+    emit(stage="hist_onehot", ms=round(t_onehot * 1e3, 3))
+
+    # --- grow_tree isolation at bench shape (255 leaves) ----------------
+    from lightgbm_tpu.ops.grower import GrowerConfig, grow_tree
+    from lightgbm_tpu.ops.split import SplitParams
+    sp = SplitParams(lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=100,
+                     min_sum_hessian_in_leaf=100.0, min_gain_to_split=0.0,
+                     max_delta_step=0.0, path_smooth=0.0, cat_smooth=10.0,
+                     cat_l2=10.0, max_cat_to_onehot=4)
+    hist_method = "pallas" if jax.default_backend() == "tpu" else "onehot"
+    cfg = GrowerConfig(num_leaves=255, max_depth=-1, max_bin=256, split=sp,
+                       feature_fraction_bynode=1.0, hist_method=hist_method,
+                       hist_chunk_rows=65536, sorted_cat=False)
+    meta = dict(num_bins=jnp.full(F, 256, jnp.int32),
+                default_bins=jnp.zeros(F, jnp.int32),
+                nan_bins=jnp.full(F, -1, jnp.int32),
+                is_categorical=jnp.zeros(F, bool),
+                monotone=jnp.zeros(F, jnp.int32))
+    grow = jax.jit(lambda b_, g_, h_, rw, fm, k: grow_tree(
+        b_, g_, h_, rw, fm, **meta, key=k, cfg=cfg))
+    rw = jnp.ones(N, jnp.float32)
+    fm = jnp.ones(F, jnp.float32)
+    key = jax.random.PRNGKey(0)
+    t = time.perf_counter()
+    tree, _ = grow(bins, g, h, rw, fm, key)
+    tree.leaf_value.block_until_ready()
+    emit(stage="grow_compile_plus_first", secs=round(time.perf_counter() - t, 1))
+    t = time.perf_counter()
+    for _ in range(3):
+        tree, _ = grow(bins, g + 1e-12, h, rw, fm, key)
+    tree.leaf_value.block_until_ready()
+    emit(stage="grow_steady", ms_per_tree=round(
+        (time.perf_counter() - t) / 3 * 1e3, 1))
+
+    # --- headline bench (in-process, same params as bench.py) ----------
+    # one coherent shape for the whole story (a leftover BENCH_ROWS env
+    # var must not decouple the headline from the micro stages); probe
+    # already done above
+    os.environ["BENCH_ROWS"] = str(ROWS)
+    os.environ["BENCH_SKIP_PROBE"] = "1"
+    import contextlib, io
+    import bench
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    line = [l for l in buf.getvalue().splitlines() if l.startswith("{")]
+    emit(stage="headline_bench",
+         **(json.loads(line[-1]) if line else {"error": buf.getvalue()[-300:]}))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
