@@ -33,6 +33,24 @@ def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 42):
     return X, y
 
 
+def probe_backend(timeout: float = 300.0) -> bool:
+    """True when the ambient backend answers a trivial matmul within
+    ``timeout`` seconds, probed in a SUBPROCESS (a wedged axon tunnel hangs
+    rather than errors).  Shared by the bench fallback and
+    scripts/tpu_perf_suite.py."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "(jnp.ones((64,64)) @ jnp.ones((64,64))).block_until_ready();"
+             "print('live')"],
+            timeout=timeout, capture_output=True, text=True)
+        return "live" in (r.stdout or "")
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def _ensure_live_backend() -> bool:
     """Probe the ambient JAX backend in a SUBPROCESS before committing this
     process to it.  The axon TPU tunnel, when wedged by a previous killed
@@ -41,23 +59,12 @@ def _ensure_live_backend() -> bool:
     an explicit flag so the output is still one honest JSON line (detail
     carries ``tpu_unreachable: true``).  Returns True when the ambient
     backend is usable."""
-    import subprocess
     if os.environ.get("_BENCH_REEXEC") or os.environ.get("BENCH_SKIP_PROBE"):
         return True
     if "axon" not in os.environ.get("JAX_PLATFORMS", "axon"):
         return True
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp;"
-             "(jnp.ones((64,64)) @ jnp.ones((64,64))).block_until_ready();"
-             "print('live')"],
-            timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", 300)),
-            capture_output=True, text=True)
-        if "live" in (r.stdout or ""):
-            return True
-    except subprocess.TimeoutExpired:
-        pass
+    if probe_backend(float(os.environ.get("BENCH_PROBE_TIMEOUT", 300))):
+        return True
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     bench_dir = os.path.dirname(os.path.abspath(__file__))

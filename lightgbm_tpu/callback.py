@@ -26,9 +26,15 @@ def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
     def _callback(env: CallbackEnv) -> None:
         if period > 0 and env.evaluation_result_list and \
                 (env.iteration + 1) % period == 0:
+            def fmt(entry):
+                # cv passes 5-tuples carrying the across-fold stdv
+                if len(entry) == 5 and show_stdv:
+                    n, m, v, _, sd = entry
+                    return f"{n}'s {m}: {v:g} + {sd:g}"
+                n, m, v = entry[0], entry[1], entry[2]
+                return f"{n}'s {m}: {v:g}"
             result = "\t".join(
-                f"{name}'s {metric}: {value:g}"
-                for name, metric, value, _ in env.evaluation_result_list)
+                fmt(e) for e in env.evaluation_result_list)
             Log.info("[%d]\t%s", env.iteration + 1, result)
     _callback.order = 10
     return _callback
@@ -43,14 +49,14 @@ def record_evaluation(eval_result: Dict) -> Callable:
 
     def _init(env: CallbackEnv) -> None:
         eval_result.clear()
-        for name, metric, _, _ in env.evaluation_result_list:
+        for name, metric, *_ in env.evaluation_result_list:
             eval_result.setdefault(name, collections.OrderedDict())
             eval_result[name].setdefault(metric, [])
 
     def _callback(env: CallbackEnv) -> None:
         if not eval_result:
             _init(env)
-        for name, metric, value, _ in env.evaluation_result_list:
+        for name, metric, value, *_ in env.evaluation_result_list:
             eval_result.setdefault(name, collections.OrderedDict()).setdefault(
                 metric, []).append(value)
     _callback.order = 20
@@ -99,7 +105,8 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         if verbose:
             Log.info("Training until validation scores don't improve for %d rounds", stopping_rounds)
         first_metric[0] = env.evaluation_result_list[0][1]
-        for name, metric, _, higher_better in env.evaluation_result_list:
+        for entry in env.evaluation_result_list:
+            name, metric, higher_better = entry[0], entry[1], entry[3]
             best_iter.append(0)
             best_score_list.append(None)
             if higher_better:
@@ -114,14 +121,16 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             _init(env)
         if not enabled[0]:
             return
-        for i, (name, metric, score, _) in enumerate(env.evaluation_result_list):
+        for i, entry in enumerate(env.evaluation_result_list):
+            name, metric, score = entry[0], entry[1], entry[2]
             if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
                 best_score[i] = score
                 best_iter[i] = env.iteration
                 best_score_list[i] = env.evaluation_result_list
             if first_metric_only and first_metric[0] != metric:
                 continue
-            if name == "training":
+            if name == "training" or (name == "cv_agg"
+                                      and metric.startswith("train")):
                 continue
             if env.iteration - best_iter[i] >= stopping_rounds:
                 if verbose:

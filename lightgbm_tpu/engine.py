@@ -145,10 +145,14 @@ def _make_n_folds(full_data: Dataset, nfold: int, params, seed: int,
 def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
        metrics=None, fobj=None, feval=None, init_model=None,
-       early_stopping_rounds: Optional[int] = None, seed: int = 0,
+       feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds: Optional[int] = None, fpreproc=None,
+       verbose_eval=None, show_stdv: bool = True, seed: int = 0,
        callbacks=None, eval_train_metric: bool = False,
        return_cvbooster: bool = False) -> Dict[str, List[float]]:
-    """Cross-validation (reference ``engine.py:392``)."""
+    """Cross-validation (reference ``engine.py:392``): per-fold boosters,
+    aggregated mean/stdv curves, optional ``fpreproc`` per-fold transform,
+    callbacks over the aggregate (``cv_agg``) results."""
     params = dict(params or {})
     if metrics is not None:
         params["metric"] = metrics
@@ -157,6 +161,11 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     if params.get("objective") in (None, "regression") and stratified:
         stratified = False
 
+    if feature_name != "auto":
+        train_set.set_feature_name(feature_name)
+    if categorical_feature != "auto":
+        # construct-aware: resets a built Dataset for re-binning
+        train_set.set_categorical_feature(categorical_feature)
     train_set.construct()
     results: Dict[str, List[float]] = {}
     cvbooster = CVBooster()
@@ -171,32 +180,73 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     for train_idx, test_idx in folds:
         tr = train_set.subset(train_idx)
         te = train_set.subset(test_idx)
-        bst = Booster(params=params, train_set=tr)
+        fold_params = dict(params)
+        if fpreproc is not None:
+            # per-fold preprocessing hook (reference fpreproc contract:
+            # (dtrain, dtest, params) -> same triple)
+            tr, te, fold_params = fpreproc(tr, te, dict(params))
+        bst = Booster(params=fold_params, train_set=tr)
         bst.add_valid(te, "valid")
         fold_boosters.append(bst)
         cvbooster.append(bst)
 
+    from . import callback as callback_mod
     cbs = list(callbacks or [])
+    cbs_before = [c for c in cbs if getattr(c, "before_iteration", False)]
+    cbs_after = [c for c in cbs if not getattr(c, "before_iteration", False)]
+    if verbose_eval:
+        period = 1 if verbose_eval is True else int(verbose_eval)
+        cbs_after.append(callback_mod.print_evaluation(period, show_stdv))
+    for c in (cbs_before, cbs_after):
+        c.sort(key=lambda cb: getattr(cb, "order", 0))
+
     best_iter = num_boost_round
-    best_scores: Dict[str, float] = {}
     no_improve = 0
     best_mean: Dict[str, float] = {}
     for i in range(num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(cvbooster, params, i, 0,
+                                        num_boost_round, None))
         agg: Dict[str, List[float]] = {}
         hib_map: Dict[str, bool] = {}
         for bst in fold_boosters:
             bst.update(fobj=fobj)
-            for name, metric, val, hib in bst._gbdt.eval_current():
+            res = bst._gbdt.eval_current()
+            for name, metric, val, hib in res:
                 if name == "training" and not eval_train_metric:
                     continue
                 key = f"{name} {metric}"
                 agg.setdefault(key, []).append(val)
                 hib_map[key] = hib
-        stop_now = False
+            if feval is not None:
+                score = np.asarray(bst._gbdt._valid_scores[0], np.float64)
+                s = (score[0] if bst._gbdt.num_tree_per_iteration == 1
+                     else score)
+                # the PYTHON-level Dataset (get_label/get_weight), not the
+                # inner binned one
+                vds = (bst.valid_sets_py[0]
+                       if getattr(bst, "valid_sets_py", None) else None)
+                fres = feval(s, vds)
+                if isinstance(fres, tuple):
+                    fres = [fres]
+                for mname, val, hib in fres:
+                    key = f"valid {mname}"
+                    agg.setdefault(key, []).append(val)
+                    hib_map[key] = hib
+        env_list = [("cv_agg", key, float(np.mean(vals)), hib_map[key],
+                     float(np.std(vals))) for key, vals in agg.items()]
         for key, vals in agg.items():
             results.setdefault(f"{key}-mean", []).append(float(np.mean(vals)))
             results.setdefault(f"{key}-stdv", []).append(float(np.std(vals)))
-        if early_stopping_rounds and agg:
+        stop_now = False
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(cvbooster, params, i, 0,
+                                            num_boost_round, env_list))
+        except callback_mod.EarlyStopException as e:
+            best_iter = e.best_iteration + 1
+            stop_now = True
+        if early_stopping_rounds and agg and not stop_now:
             key0 = next(iter(agg))
             mean0 = float(np.mean(agg[key0]))
             better = (mean0 > best_mean.get(key0, -np.inf)) if hib_map[key0] \

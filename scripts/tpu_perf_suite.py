@@ -32,23 +32,12 @@ def emit(**kv):
 def main():
     # wedge-safe: prove the backend live in a TIMEOUT-GUARDED subprocess
     # before this process commits to it (a wedged tunnel hangs forever)
-    import subprocess
+    import bench
     if "axon" in os.environ.get("JAX_PLATFORMS", "axon") \
-            and not os.environ.get("_SUITE_PROBED"):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax, jax.numpy as jnp;"
-                 "(jnp.ones((64,64)) @ jnp.ones((64,64))).block_until_ready();"
-                 "print('live')"],
-                timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", 300)),
-                capture_output=True, text=True)
-            live = "live" in (r.stdout or "")
-        except subprocess.TimeoutExpired:
-            live = False
-        if not live:
-            emit(stage="abort", reason="tpu_unreachable")
-            return 1
+            and not bench.probe_backend(
+                float(os.environ.get("BENCH_PROBE_TIMEOUT", 300))):
+        emit(stage="abort", reason="tpu_unreachable")
+        return 1
 
     import jax
     import jax.numpy as jnp

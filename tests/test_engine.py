@@ -926,3 +926,36 @@ def test_force_col_row_wise(binary_data):
         lgb.train({"objective": "binary", "force_col_wise": True,
                    "force_row_wise": True, "verbose": -1},
                   lgb.Dataset(Xtr, label=ytr), num_boost_round=1)
+
+
+def test_cv_fpreproc_and_callbacks(binary_data):
+    """cv: fpreproc per-fold hook, cv_agg callback results with stdv,
+    verbose_eval period, early_stopping callback (reference engine.py cv)."""
+    Xtr, ytr, _, _ = binary_data
+    seen = []
+
+    def fpreproc(dtrain, dtest, params):
+        seen.append((dtrain.num_data(), dtest.num_data()))
+        return dtrain, dtest, dict(params, learning_rate=0.2)
+
+    hist = {}
+    res = lgb.cv({"objective": "binary", "metric": "auc", "num_leaves": 7,
+                  "verbose": -1},
+                 lgb.Dataset(Xtr, label=ytr), num_boost_round=6, nfold=3,
+                 fpreproc=fpreproc, verbose_eval=2, show_stdv=True,
+                 callbacks=[lgb.record_evaluation(hist)], seed=3)
+    assert len(seen) == 3 and all(a + b == len(ytr) for a, b in seen)
+    assert len(res["valid auc-mean"]) == 6
+    assert "cv_agg" in hist and len(hist["cv_agg"]["valid auc"]) == 6
+
+
+def test_cv_early_stopping_callback(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    res = lgb.cv({"objective": "binary", "metric": "binary_logloss",
+                  "num_leaves": 31, "min_data_in_leaf": 2, "verbose": -1},
+                 lgb.Dataset(Xtr, label=ytr), num_boost_round=60, nfold=3,
+                 callbacks=[lgb.early_stopping(3, verbose=False)],
+                 return_cvbooster=True, seed=1)
+    cvb = res["cvbooster"]
+    assert 0 < cvb.best_iteration <= 60
+    assert len(res["valid binary_logloss-mean"]) == cvb.best_iteration
