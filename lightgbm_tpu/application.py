@@ -111,7 +111,7 @@ class Application:
             raise LightGBMError("no data to predict: set data=<file>")
         booster = Booster(model_file=self._resolve(cfg.input_model))
         from .io.loader import load_file
-        X, _, _ = load_file(self._resolve(cfg.data), cfg)
+        X = load_file(self._resolve(cfg.data), cfg)[0]
         pred = booster.predict(
             X, raw_score=cfg.predict_raw_score,
             pred_leaf=cfg.predict_leaf_index,
@@ -145,7 +145,7 @@ class Application:
             raise LightGBMError("no data: set data=<file>")
         booster = Booster(model_file=self._resolve(cfg.input_model))
         from .io.loader import load_file
-        X, y, _ = load_file(self._resolve(cfg.data), cfg)
+        X, y = load_file(self._resolve(cfg.data), cfg)[:2]
         booster.refit(X, y, decay_rate=cfg.refit_decay_rate)
         booster.save_model(cfg.output_model)
         Log.info("Finished refit; model saved to %s", cfg.output_model)

@@ -53,9 +53,14 @@ class Dataset:
             from .io.loader import load_file
             import os as _os
             path = data
-            data, label, feat_names = load_file(path, cfg)
+            data, label, feat_names, fweight, fgroup = load_file(path, cfg)
             if self.label is None:
                 self.label = label
+            # weight_column / group_column roles (reference Metadata::Init)
+            if self.weight is None and fweight is not None:
+                self.weight = fweight
+            if self.group is None and fgroup is not None:
+                self.group = fgroup
             if self.feature_name == "auto" and feat_names:
                 self.feature_name = feat_names
             # sidecar metadata files, auto-detected like the reference

@@ -104,7 +104,9 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             raise ValueError("For early stopping, at least one dataset and eval metric is required for evaluation")
         if verbose:
             Log.info("Training until validation scores don't improve for %d rounds", stopping_rounds)
-        first_metric[0] = env.evaluation_result_list[0][1]
+        # cv entries carry composite "<set> <metric>" keys; compare
+        # bare metric names (reference .split(" ")[-1])
+        first_metric[0] = env.evaluation_result_list[0][1].split(" ")[-1]
         for entry in env.evaluation_result_list:
             name, metric, higher_better = entry[0], entry[1], entry[3]
             best_iter.append(0)
@@ -127,7 +129,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                 best_score[i] = score
                 best_iter[i] = env.iteration
                 best_score_list[i] = env.evaluation_result_list
-            if first_metric_only and first_metric[0] != metric:
+            if first_metric_only and first_metric[0] != metric.split(" ")[-1]:
                 continue
             if name == "training" or (name == "cv_agg"
                                       and metric.startswith("train")):

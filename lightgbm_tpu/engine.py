@@ -219,20 +219,28 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                 agg.setdefault(key, []).append(val)
                 hib_map[key] = hib
             if feval is not None:
-                score = np.asarray(bst._gbdt._valid_scores[0], np.float64)
-                s = (score[0] if bst._gbdt.num_tree_per_iteration == 1
-                     else score)
-                # the PYTHON-level Dataset (get_label/get_weight), not the
-                # inner binned one
-                vds = (bst.valid_sets_py[0]
-                       if getattr(bst, "valid_sets_py", None) else None)
-                fres = feval(s, vds)
-                if isinstance(fres, tuple):
-                    fres = [fres]
-                for mname, val, hib in fres:
-                    key = f"valid {mname}"
-                    agg.setdefault(key, []).append(val)
-                    hib_map[key] = hib
+                # the PYTHON-level Datasets (get_label/get_weight), not the
+                # inner binned ones; feval runs on every eval set like the
+                # reference (training included when eval_train_metric)
+                evals = [("valid",
+                          np.asarray(bst._gbdt._valid_scores[0], np.float64),
+                          bst.valid_sets_py[0]
+                          if getattr(bst, "valid_sets_py", None) else None)]
+                if eval_train_metric:
+                    evals.append(("training",
+                                  np.asarray(bst._gbdt._train_score,
+                                             np.float64),
+                                  bst.train_set))
+                for ename, score, dset in evals:
+                    s = (score[0] if bst._gbdt.num_tree_per_iteration == 1
+                         else score)
+                    fres = feval(s, dset)
+                    if isinstance(fres, tuple):
+                        fres = [fres]
+                    for mname, val, hib in fres:
+                        key = f"{ename} {mname}"
+                        agg.setdefault(key, []).append(val)
+                        hib_map[key] = hib
         env_list = [("cv_agg", key, float(np.mean(vals)), hib_map[key],
                      float(np.std(vals))) for key, vals in agg.items()]
         for key, vals in agg.items():

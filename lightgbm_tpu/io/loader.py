@@ -30,16 +30,18 @@ def _detect_format(first_lines: List[str]) -> str:
     return "csv"
 
 
-def load_file(path: str, config: Optional[Config] = None
-              ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[List[str]]]:
-    """Load a data file -> (features, label, feature_names)."""
+def load_file(path: str, config: Optional[Config] = None):
+    """Load a data file -> (features, label, feature_names, weight,
+    group_sizes); the last two come from ``weight_column``/``group_column``
+    (None otherwise)."""
     cfg = config or Config()
     check(os.path.exists(path), f"data file {path} does not exist")
     with open(path) as f:
         head = [f.readline() for _ in range(3)]
     fmt = _detect_format(head)
     if fmt == "libsvm":
-        return _load_libsvm(path)
+        feat, label, names = _load_libsvm(path)
+        return feat, label, names, None, None
     delim = "\t" if fmt == "tsv" else ","
     return _load_delimited(path, delim, cfg)
 
@@ -73,7 +75,50 @@ def _load_delimited(path: str, delim: str, cfg: Config):
     feat = np.delete(data, label_idx, axis=1)
     if names:
         names = [n for i, n in enumerate(names) if i != label_idx]
-    return feat, label, names
+
+    # weight / group / ignore columns (reference DatasetLoader::SetHeader,
+    # src/io/dataset_loader.cpp — numeric indices DON'T count the label
+    # column, so they resolve against the label-less matrix)
+    def resolve(spec: str) -> List[int]:
+        out = []
+        for item in str(spec).split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if item.startswith("name:"):
+                item = item[5:]
+            if names is not None and item in names:
+                out.append(names.index(item))
+            else:
+                check(item.isdigit(),
+                      f"column '{item}' not found (name-based columns "
+                      "require header=true; numeric indices must be "
+                      ">= 0 and not count the label column)")
+                out.append(int(item))
+        return out
+
+    weight = group = None
+    drop: List[int] = []
+    if cfg.weight_column:
+        widx, = resolve(cfg.weight_column)
+        weight = feat[:, widx].astype(np.float32)
+        drop.append(widx)
+    if cfg.group_column:
+        gidx, = resolve(cfg.group_column)
+        qid = feat[:, gidx]
+        # per-row query ids -> group sizes over consecutive runs
+        change = np.nonzero(np.diff(qid) != 0)[0] + 1
+        bounds = np.concatenate([[0], change, [len(qid)]])
+        group = np.diff(bounds).astype(np.int64)
+        drop.append(gidx)
+    if cfg.ignore_column:
+        drop.extend(resolve(cfg.ignore_column))
+    if drop:
+        keep = [i for i in range(feat.shape[1]) if i not in set(drop)]
+        feat = feat[:, keep]
+        if names:
+            names = [names[i] for i in keep]
+    return feat, label, names, weight, group
 
 
 def _load_libsvm(path: str):

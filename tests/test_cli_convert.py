@@ -187,3 +187,47 @@ def test_cli_tree_learner_data(cli_files, binary_data):
     from sklearn.metrics import roc_auc_score
     assert abs(roc_auc_score(yte, p_dp) - roc_auc_score(yte, p_s)) < 0.01
     assert np.corrcoef(p_dp, p_s)[0, 1] > 0.99
+
+
+def test_column_roles_from_file(tmp_path):
+    """weight_column / group_column / ignore_column resolve (by index, not
+    counting the label column, and by name with header) and feed metadata
+    (reference DatasetLoader::SetHeader)."""
+    rng = np.random.default_rng(0)
+    n = 400
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    w = rng.uniform(0.5, 2.0, n).round(3)
+    qid = np.repeat(np.arange(n // 20), 20)          # 20 rows per query
+    junk = np.full(n, 7.0)
+    # layout: label, f0..f3, weight, qid, junk
+    mat = np.column_stack([y, X, w, qid, junk])
+    path = tmp_path / "roles.csv"
+    header = "target,f0,f1,f2,f3,w,qid,junk"
+    np.savetxt(path, mat, delimiter=",", fmt="%.6g", header=header,
+               comments="")
+    ds = lgb.Dataset(str(path), params={
+        "header": True, "label_column": "name:target",
+        "weight_column": "name:w", "group_column": "name:qid",
+        "ignore_column": "name:junk"})
+    ds.construct()
+    assert ds.num_feature() == 4
+    np.testing.assert_allclose(ds.get_weight(), w, rtol=1e-5)
+    np.testing.assert_array_equal(ds.get_group(), np.full(n // 20, 20))
+    assert ds.get_feature_name() == ["f0", "f1", "f2", "f3"]
+    # same by indices (not counting the label column), no header
+    np.savetxt(tmp_path / "roles2.csv", mat, delimiter=",", fmt="%.6g")
+    ds2 = lgb.Dataset(str(tmp_path / "roles2.csv"), params={
+        "label_column": "0", "weight_column": "4", "group_column": "5",
+        "ignore_column": "6"})
+    ds2.construct()
+    assert ds2.num_feature() == 4
+    np.testing.assert_allclose(ds2.get_weight(), w, rtol=1e-5)
+    # lambdarank end-to-end on the file-declared groups
+    bst = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                     "ndcg_eval_at": [5], "verbose": -1, "header": True,
+                     "label_column": "name:target",
+                     "weight_column": "name:w", "group_column": "name:qid",
+                     "ignore_column": "name:junk", "min_data_in_leaf": 5},
+                    lgb.Dataset(str(path)), num_boost_round=3)
+    assert bst.num_trees() == 3
