@@ -85,9 +85,14 @@ def _load_delimited(path: str, delim: str, cfg: Config):
             item = item.strip()
             if not item:
                 continue
-            if item.startswith("name:"):
+            by_name = item.startswith("name:")
+            if by_name:
                 item = item[5:]
-            if names is not None and item in names:
+            # bare digits are ALWAYS indices (reference semantics) — a
+            # header column literally named '4' must use the name: prefix
+            if not by_name and item.isdigit():
+                out.append(int(item))
+            elif names is not None and item in names:
                 out.append(names.index(item))
             else:
                 check(item.isdigit(),

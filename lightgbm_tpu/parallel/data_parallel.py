@@ -45,53 +45,81 @@ def make_dp_train_step(grower_cfg: GrowerConfig,
                        grad_fn: Callable,
                        learning_rate: float,
                        mesh: jax.sharding.Mesh,
-                       axis_name: str = DATA_AXIS):
+                       axis_name: str = DATA_AXIS,
+                       num_class: int = 1):
     """Build a jitted data-parallel one-iteration training step.
 
     Args:
       grower_cfg: static grower config; its ``axis_name`` is overridden.
       feature_meta: dict with replicated per-feature arrays
         (num_bins, default_bins, nan_bins, is_categorical, monotone).
-      grad_fn: ``(score[n], label[n]) -> (grad[n], hess[n])`` elementwise
-        objective gradient (runs shard-local).
+      grad_fn: elementwise shard-local objective gradient —
+        ``(score[n], label[n], weight[n]|None) -> (grad[n], hess[n])`` for
+        one class, or ``(score[K,n], label, weight) -> ([K,n], [K,n])``
+        when ``num_class > 1`` (softmax couples the classes, so gradients
+        come from the full score matrix).
       learning_rate: shrinkage applied to leaf values in the score update.
+      num_class: trees grown per iteration (one per class, in one
+        ``lax.scan`` so the program compiles once).
 
-    Returns a jitted function
-      ``(bins[N,F], label[N], score[N], row_weight[N], fmask[F], key)
-        -> (new_score[N], TreeArrays)``
-    with rows sharded over ``axis_name`` and the tree replicated.
+    Returns a function
+      ``(bins[N,F], label[N], score[N] or [K,N], row_weight[N], fmask[F],
+         key, weight=None) -> (new_score, TreeArrays)``
+    with rows sharded over ``axis_name`` and the tree(s) replicated
+    (leaf arrays gain a leading class axis when ``num_class > 1``).
+    ``row_weight`` carries the pad/bag mask; ``weight`` (or None) the user
+    sample weights, applied inside the objective like the single-process
+    engine (counts stay mask-based).
     """
     cfg = grower_cfg._replace(axis_name=axis_name)
     fm = feature_meta
+    K = num_class
 
-    def step(bins, label, score, row_weight, fmask, key):
-        grad, hess = grad_fn(score, label)
+    def one_tree(grad, hess, bins, row_weight, fmask, key):
         tree, node_assign = grow_tree(
             bins, grad, hess, row_weight, fmask,
             fm["num_bins"], fm["default_bins"], fm["nan_bins"],
             fm["is_categorical"], fm["monotone"], key, cfg)
         delta = tree.leaf_value * learning_rate
         has_split = tree.num_leaves > 1
-        new_score = score + jnp.where(has_split, delta[node_assign], 0.0)
-        return new_score, tree
+        return jnp.where(has_split, delta[node_assign], 0.0), tree
 
+    def step(bins, label, score, row_weight, weight, fmask, key):
+        if K == 1:
+            grad, hess = grad_fn(score, label, weight)
+            d, tree = one_tree(grad, hess, bins, row_weight, fmask, key)
+            return score + d, tree
+        grads, hesses = grad_fn(score, label, weight)        # [K, n] each
+
+        def body(carry, xs):
+            g, h, k = xs
+            d, tree = one_tree(g, h, bins, row_weight, fmask, k)
+            return carry, (d, tree)
+
+        keys = jax.random.split(key, K)
+        _, (deltas, trees) = jax.lax.scan(
+            body, 0, (grads, hesses, keys))
+        return score + deltas, trees
+
+    score_spec = P(axis_name) if K == 1 else P(None, axis_name)
     sharded = jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), P(axis_name), P(axis_name),
-                  P(), P()),
-        out_specs=(P(axis_name), P()),
+        in_specs=(P(axis_name), P(axis_name), score_spec, P(axis_name),
+                  P(axis_name), P(), P()),
+        out_specs=(score_spec, P()),
         check_vma=False)  # tree outputs are replicated by construction (psum)
     jitted = jax.jit(sharded)
     n_shards = mesh.shape[axis_name]
 
-    @functools.wraps(jitted)
-    def checked(bins, label, score, row_weight, fmask, key):
+    def checked(bins, label, score, row_weight, fmask, key, weight=None):
         if bins.shape[0] % n_shards:
             raise ValueError(
                 f"row count {bins.shape[0]} is not divisible by the "
                 f"{n_shards}-way '{axis_name}' mesh axis; pad rows with "
                 f"pad_rows_to_multiple() and zero row_weight for pad rows")
-        return jitted(bins, label, score, row_weight, fmask, key)
+        if weight is None:
+            weight = jnp.ones_like(label)
+        return jitted(bins, label, score, row_weight, weight, fmask, key)
     return checked
 
 

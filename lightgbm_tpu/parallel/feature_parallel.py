@@ -46,7 +46,10 @@ def make_fp_train_step(grower_cfg: GrowerConfig,
     fm = feature_meta
 
     def step(bins, label, score, row_weight, fmask, key):
-        grad, hess = grad_fn(score, label)
+        # shared grad_fn convention with make_dp_train_step:
+        # (score, label, weight); sample weights are not
+        # wired through this learner's step
+        grad, hess = grad_fn(score, label, None)
         tree, node_assign = grow_tree(
             bins, grad, hess, row_weight, fmask,
             fm["num_bins"], fm["default_bins"], fm["nan_bins"],

@@ -26,16 +26,17 @@ from .mesh import DATA_AXIS
 
 
 def train_distributed(params, data, label, num_boost_round: Optional[int] = None,
-                      feature_name=None, categorical_feature=None):
+                      weight=None, feature_name=None,
+                      categorical_feature=None):
     """Train over every ``jax.distributed`` process's local partition and
     return a ``Booster`` (identical on every process).
 
-    ``data``/``label`` are THIS process's rows.  Requires
+    ``data``/``label``/``weight`` are THIS process's rows.  Requires
     ``parallel.mesh.init_distributed`` to have run.  Single-process calls
-    degrade to the ordinary engine.  v1 scope: one model per iteration
-    objectives with mean-based boost_from_average (regression l2, binary);
-    sample weights and valid sets are not yet wired through the
-    multi-process loop.
+    degrade to the ordinary engine.  Supports regression/binary/multiclass
+    objectives (globally pooled boost_from_average) and sample weights;
+    per-iteration row/feature sampling and valid-set evaluation still
+    belong to the single-host loop and are rejected explicitly.
     """
     import jax
     import jax.numpy as jnp
@@ -48,7 +49,7 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
         # v1: the shard_map step runs bins as plain per-feature columns
         cfg.enable_bundle = False
 
-    ds = distributed_dataset(data, cfg, label=label,
+    ds = distributed_dataset(data, cfg, label=label, weight=weight,
                              categorical_feature=categorical_feature,
                              feature_names=feature_name)
     if jax.process_count() == 1:
@@ -63,12 +64,10 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
     from ..models.gbdt import GBDT
     from ..models.tree import Tree
 
-    check(cfg.num_class <= 1 or cfg.objective in ("regression", "binary"),
-          "train_distributed v1 supports single-model-per-iteration "
-          "objectives")
     objective = create_objective(cfg)
-    check(objective is not None and objective.num_model_per_iteration == 1,
-          "train_distributed v1 supports one tree per iteration")
+    check(objective is not None,
+          "train_distributed requires a built-in objective")
+    K = objective.num_model_per_iteration
     # reject configs the fixed-ones row/feature masks would silently ignore
     # (the per-iteration sampling machinery lives in the full GBDT loop)
     check(cfg.bagging_freq == 0 or (cfg.bagging_fraction >= 1.0
@@ -93,37 +92,45 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
     label_np = np.asarray(ds.metadata.label, np.float32)
     label_l = np.pad(label_np, (0, pad))
     rw_l = np.pad(np.ones(n_local, np.float32), (0, pad))
+    w_np = (np.asarray(ds.metadata.weight, np.float32)
+            if ds.metadata.weight is not None else np.ones(n_local, np.float32))
+    w_l = np.pad(w_np, (0, pad))
     N = per_proc * jax.process_count()
 
     mesh = Mesh(np.array(jax.devices()), (DATA_AXIS,))
     sh = NamedSharding(mesh, P(DATA_AXIS))
     mk = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
         sh, a, (N,) + a.shape[1:])
-    bins_g, label_g, rw_g = mk(bins_l), mk(label_l), mk(rw_l)
+    bins_g, label_g, rw_g, w_g = mk(bins_l), mk(label_l), mk(rw_l), mk(w_l)
 
     # --- GLOBAL boost-from-average: only the weighted label sum/count
     # crosses processes (two scalars), then the objective's own formula
     # applies.  A per-process mean would give each rank a different init.
-    init = 0.0
+    inits = [0.0] * K
     if cfg.boost_from_average:
-        sums = np.asarray(mhu.process_allgather(
-            np.asarray([float(label_np.sum()), float(n_local)])))
-        wl, w = float(sums[:, 0].sum()), float(sums[:, 1].sum())
         if cfg.objective == "regression":
-            init = wl / max(w, 1.0)          # pooled mean (RegressionL2)
-        elif cfg.objective == "binary":
-            # binary labels are 0/1, so a two-point weighted surrogate
-            # reproduces the pooled pavg exactly and reuses the
-            # objective's own initscore formula (sigmoid scaling etc.)
+            sums = np.asarray(mhu.process_allgather(np.asarray(
+                [float((w_np * label_np).sum()), float(w_np.sum())])))
+            inits = [float(sums[:, 0].sum()) / max(float(sums[:, 1].sum()),
+                                                   1e-12)]
+        elif cfg.objective in ("binary", "multiclass", "multiclassova"):
+            # class-frequency objectives: pool the per-class WEIGHTED
+            # counts (a [C] vector), then feed a C-point weighted
+            # surrogate through the objective's own initscore formula —
+            # exact, because these formulas depend only on class
+            # frequencies
+            C = max(2, cfg.num_class)
+            local = np.bincount(label_np.astype(np.int64), weights=w_np,
+                                minlength=C).astype(np.float64)
+            pooled = np.asarray(
+                mhu.process_allgather(local)).reshape(-1, C).sum(axis=0)
             from ..io.dataset import Metadata
-            surrogate = Metadata(2)
-            surrogate.set_field("label", np.asarray([0.0, 1.0]))
-            surrogate.set_field("weight",
-                                np.asarray([max(w - wl, 1e-12),
-                                            max(wl, 1e-12)]))
+            surrogate = Metadata(C)
+            surrogate.set_field("label", np.arange(C, dtype=np.float64))
+            surrogate.set_field("weight", np.maximum(pooled, 1e-12))
             obj2 = create_objective(cfg)
-            obj2.init(surrogate, 2)
-            init = obj2.boost_from_score(0)
+            obj2.init(surrogate, C)
+            inits = [obj2.boost_from_score(k) for k in range(K)]
         else:
             Log.warning("train_distributed: boost_from_average for "
                         "objective %s is not pooled globally; starting "
@@ -141,35 +148,50 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
                 nan_bins=dd.nan_bins, is_categorical=dd.is_categorical,
                 monotone=dd.monotone)
 
-    def grad_fn(score, lab):
-        return objective.get_gradients(score, lab, None)
+    if K == 1:
+        def grad_fn(score, lab, w):
+            return objective.get_gradients(score, lab, w)
+    else:
+        def grad_fn(score, lab, w):
+            return objective.get_gradients_multi(score, lab, w)
 
-    step = make_dp_train_step(gcfg, meta, grad_fn, cfg.learning_rate, mesh)
+    step = make_dp_train_step(gcfg, meta, grad_fn, cfg.learning_rate, mesh,
+                              num_class=K)
     fmask = jnp.ones(ds.num_features, jnp.float32)
-    score = jax.make_array_from_process_local_data(
-        sh, np.full((per_proc,), init, np.float32), (N,))
+    if K == 1:
+        score_l = np.full((per_proc,), inits[0], np.float32)
+        score = mk(score_l)
+    else:
+        score_l = np.tile(np.asarray(inits, np.float32)[:, None],
+                          (1, per_proc))
+        score = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P(None, DATA_AXIS)), score_l, (K, N))
 
     trees = []
     for it in range(rounds):
         key = key_for_iteration(cfg.seed, it, salt=1)
-        score, tree_arrays = step(bins_g, label_g, score, rw_g, fmask, key)
+        score, tree_arrays = step(bins_g, label_g, score, rw_g, fmask, key,
+                                  weight=w_g)
         host = jax.device_get(tree_arrays)
-        t = Tree.from_arrays(host, ds, learning_rate=1.0)
-        t.shrink(cfg.learning_rate)
-        if it == 0 and init != 0.0:
-            if int(host.num_leaves) > 1:
-                t.add_bias(init)
-            else:
-                t.leaf_value = np.full_like(t.leaf_value, init)
-        trees.append(t)
+        for k in range(K):
+            hk = (host if K == 1
+                  else jax.tree.map(lambda a: a[k], host))
+            t = Tree.from_arrays(hk, ds, learning_rate=1.0)
+            t.shrink(cfg.learning_rate)
+            if it == 0 and inits[k] != 0.0:
+                if int(hk.num_leaves) > 1:
+                    t.add_bias(inits[k])
+                else:
+                    t.leaf_value = np.full_like(t.leaf_value, inits[k])
+            trees.append(t)
 
     # --- identical Booster on every process -----------------------------
     gbdt = GBDT(cfg)
     gbdt.train_data = ds
     gbdt.objective = objective
     gbdt.models = trees
-    gbdt.init_scores = [init]
-    gbdt.num_tree_per_iteration = 1
+    gbdt.init_scores = list(inits)
+    gbdt.num_tree_per_iteration = K
     gbdt.max_feature_idx = ds.num_total_features - 1
     gbdt.iter_ = rounds
     from ..models import model_io

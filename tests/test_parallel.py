@@ -40,10 +40,13 @@ def _data(n, f, max_bin, seed=0):
     return bins, label
 
 
-def _grad_fn(score, label):
+def _grad_fn(score, label, weight=None):
     y = jnp.where(label > 0, 1.0, -1.0)
     resp = -y / (1.0 + jnp.exp(y * score))
-    return resp, jnp.abs(resp) * (1.0 - jnp.abs(resp))
+    g, h = resp, jnp.abs(resp) * (1.0 - jnp.abs(resp))
+    if weight is not None:
+        g, h = g * weight, h * weight
+    return g, h
 
 
 def test_eight_devices_available():
