@@ -26,17 +26,22 @@ from .mesh import DATA_AXIS
 
 
 def train_distributed(params, data, label, num_boost_round: Optional[int] = None,
-                      weight=None, feature_name=None,
-                      categorical_feature=None):
+                      weight=None, valid_data=None,
+                      early_stopping_rounds: Optional[int] = None,
+                      evals_result: Optional[dict] = None,
+                      feature_name=None, categorical_feature=None):
     """Train over every ``jax.distributed`` process's local partition and
     return a ``Booster`` (identical on every process).
 
-    ``data``/``label``/``weight`` are THIS process's rows.  Requires
+    ``data``/``label``/``weight`` are THIS process's rows; ``valid_data``
+    an optional ``(X_local, y_local)`` validation shard.  Requires
     ``parallel.mesh.init_distributed`` to have run.  Single-process calls
     degrade to the ordinary engine.  Supports regression/binary/multiclass
-    objectives (globally pooled boost_from_average) and sample weights;
-    per-iteration row/feature sampling and valid-set evaluation still
-    belong to the single-host loop and are rejected explicitly.
+    objectives (globally pooled boost_from_average), sample weights, and
+    validation with GLOBALLY POOLED additive metrics (l2 / logloss /
+    multi_logloss — per-process sums allgathered, so every rank sees the
+    same curve and early stopping is rank-consistent); per-iteration
+    row/feature sampling is rejected explicitly.
     """
     import jax
     import jax.numpy as jnp
@@ -56,8 +61,16 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
         from ..basic import Booster, Dataset
         wrapper = Dataset(None, params=dict(params or {}))
         wrapper._inner = ds
+        valid_sets = None
+        if valid_data is not None:
+            vw = Dataset(valid_data[0], label=valid_data[1],
+                         reference=wrapper, params=dict(params or {}))
+            valid_sets = [vw]
         from ..engine import train as _train
-        return _train(dict(params or {}), wrapper, num_boost_round=rounds)
+        return _train(dict(params or {}), wrapper, num_boost_round=rounds,
+                      valid_sets=valid_sets,
+                      early_stopping_rounds=early_stopping_rounds,
+                      evals_result=evals_result)
 
     from jax.experimental import multihost_utils as mhu
     from ..objective import create_objective
@@ -167,7 +180,57 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
         score = jax.make_array_from_process_local_data(
             NamedSharding(mesh, P(None, DATA_AXIS)), score_l, (K, N))
 
+    # --- local validation shard, binned with the SHARED mappers ---------
+    vbins = vlabel = None
+    vscore = None
+    check(valid_data is not None or not early_stopping_rounds,
+          "early_stopping_rounds requires valid_data")
+    if valid_data is not None:
+        check(cfg.objective in ("regression", "binary", "multiclass"),
+              "train_distributed pooled valid metrics support "
+              "regression/binary/multiclass (softmax) objectives")
+        from ..io.dataset import Dataset as InnerDataset
+        vds = InnerDataset.from_data(valid_data[0], cfg,
+                                     label=valid_data[1], reference=ds)
+        vbins = jnp.asarray(vds.unbundled_bins())
+        vlabel = np.asarray(vds.metadata.label, np.float64)
+        vscore = np.tile(np.asarray(inits, np.float64)[:, None],
+                         (1, vds.num_data))
+        vnan = dd.nan_bins
+
+        from ..ops.predict import predict_leaf_binned
+        vpredict = jax.jit(lambda ta, b: predict_leaf_binned(ta, b, vnan))
+
+    def pooled_metric(sc):
+        """Globally pooled additive metric on the valid shard: every
+        process contributes (sum, count) — identical value on all ranks."""
+        if cfg.objective == "regression":
+            local = np.asarray([np.sum((sc[0] - vlabel) ** 2),
+                                len(vlabel)], np.float64)
+            name = "l2"
+        elif cfg.objective == "binary":
+            # the objective's OWN transform (sigmoid scaling included) —
+            # a hand-rolled formula here drifted from convert_output once
+            p1 = np.clip(np.asarray(objective.convert_output(sc[0]),
+                                    np.float64), 1e-15, 1 - 1e-15)
+            ll = -(vlabel * np.log(p1) + (1 - vlabel) * np.log(1 - p1))
+            local = np.asarray([ll.sum(), len(vlabel)], np.float64)
+            name = "binary_logloss"
+        else:                                   # multiclass softmax
+            prob = np.clip(np.asarray(objective.convert_output(sc),
+                                      np.float64), 1e-15, 1.0)
+            ll = -np.log(prob[vlabel.astype(np.int64),
+                              np.arange(len(vlabel))])
+            local = np.asarray([ll.sum(), len(vlabel)], np.float64)
+            name = "multi_logloss"
+        pooled = np.asarray(mhu.process_allgather(local)).reshape(-1, 2)
+        return name, float(pooled[:, 0].sum() / max(pooled[:, 1].sum(), 1.0))
+
     trees = []
+    history: list = []
+    metric_name = None
+    completed = rounds
+    best_metric, best_iter_num, since_best = np.inf, rounds, 0
     for it in range(rounds):
         key = key_for_iteration(cfg.seed, it, salt=1)
         score, tree_arrays = step(bins_g, label_g, score, rw_g, fmask, key,
@@ -178,12 +241,38 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
                   else jax.tree.map(lambda a: a[k], host))
             t = Tree.from_arrays(hk, ds, learning_rate=1.0)
             t.shrink(cfg.learning_rate)
+            # valid scores start AT the init, so they accumulate the
+            # shrunk-but-UNBIASED leaf values (the bias below exists only
+            # for the standalone model file)
+            vals_unbiased = np.asarray(t.leaf_value, np.float64).copy()
             if it == 0 and inits[k] != 0.0:
                 if int(hk.num_leaves) > 1:
                     t.add_bias(inits[k])
                 else:
                     t.leaf_value = np.full_like(t.leaf_value, inits[k])
             trees.append(t)
+            if vbins is not None and int(hk.num_leaves) > 1:
+                ta_local = jax.tree.map(
+                    lambda a: jnp.asarray(a) if hasattr(a, "shape") else a,
+                    hk)
+                leaf = np.asarray(vpredict(ta_local, vbins))
+                vscore[k] += vals_unbiased[leaf]
+        if vbins is not None:
+            metric_name, mval = pooled_metric(vscore)
+            history.append(mval)
+            if mval < best_metric - 1e-12:
+                best_metric, best_iter_num, since_best = mval, it + 1, 0
+            else:
+                since_best += 1
+            if (early_stopping_rounds
+                    and since_best >= early_stopping_rounds):
+                Log.info("train_distributed: early stop at iter %d "
+                         "(best %s=%.6f @ %d)", it + 1, metric_name,
+                         best_metric, best_iter_num)
+                completed = it + 1
+                break
+    if evals_result is not None and history:
+        evals_result.setdefault("valid", {})[metric_name] = history
 
     # --- identical Booster on every process -----------------------------
     gbdt = GBDT(cfg)
@@ -193,7 +282,10 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
     gbdt.init_scores = list(inits)
     gbdt.num_tree_per_iteration = K
     gbdt.max_feature_idx = ds.num_total_features - 1
-    gbdt.iter_ = rounds
+    gbdt.iter_ = completed
     from ..models import model_io
     from ..basic import Booster
-    return Booster(model_str=model_io.save_model_to_string(gbdt))
+    bst = Booster(model_str=model_io.save_model_to_string(gbdt))
+    if history and early_stopping_rounds:
+        bst.best_iteration = best_iter_num     # sklearn/num_iteration hooks
+    return bst

@@ -286,3 +286,52 @@ def test_two_process_multiclass_weighted_training(tmp_path):
     hashes = sorted(line.split()[-1] for out in outs
                     for line in out.splitlines() if "MCHASH" in line)
     assert len(hashes) == 2 and hashes[0] == hashes[1], outs
+
+
+_VALID_WORKER = r"""
+import sys
+import numpy as np
+
+proc_id = int(sys.argv[1]); coord = sys.argv[2]
+sys.path.insert(0, "@REPO@")
+from lightgbm_tpu.parallel.mesh import init_distributed
+init_distributed(coordinator_address=coord, num_processes=2,
+                 process_id=proc_id)
+from lightgbm_tpu.parallel import train_distributed
+
+rng = np.random.default_rng(17)
+n, nv = 2000, 600
+X = rng.normal(size=(n + nv, 6))
+y = (X[:, 0] - X[:, 1] + rng.logistic(size=n + nv) * 0.4 > 0).astype(np.float32)
+Xt, yt, Xv, yv = X[:n], y[:n], X[n:], y[n:]
+lo, hi = (0, 900) if proc_id == 0 else (900, n)
+vlo, vhi = (0, 250) if proc_id == 0 else (250, nv)
+
+hist = {}
+bst = train_distributed(
+    {"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 2,
+     "max_bin": 63, "verbose": -1, "seed": 4, "learning_rate": 0.3},
+    Xt[lo:hi], yt[lo:hi], num_boost_round=60,
+    valid_data=(Xv[vlo:vhi], yv[vlo:vhi]),
+    early_stopping_rounds=5, evals_result=hist)
+curve = hist["valid"]["binary_logloss"]
+print("proc{} ROUNDS {}".format(proc_id, len(curve)))
+print("proc{} CURVE0 {:.6f} CURVEEND {:.6f}".format(
+    proc_id, curve[0], curve[-1]))
+assert len(curve) < 60, "early stopping never fired"
+assert min(curve) < curve[0]
+print("proc{} VALOK".format(proc_id))
+"""
+
+
+def test_two_process_valid_early_stopping(tmp_path):
+    """Pooled additive valid metric: identical curve on both ranks, so
+    early stopping fires consistently (reference Dask eval_set contract)."""
+    outs = _run_two_procs(tmp_path, _VALID_WORKER, timeout=420)
+    for pid, out in enumerate(outs):
+        assert f"proc{pid} VALOK" in out, out
+    rounds = {line.split()[-1] for out in outs
+              for line in out.splitlines() if "ROUNDS" in line}
+    curves = {line.split("CURVE0 ")[1] for out in outs
+              for line in out.splitlines() if "CURVE0" in line}
+    assert len(rounds) == 1 and len(curves) == 1, outs
