@@ -477,7 +477,9 @@ class Booster:
         return {"params": self.params,
                 "best_iteration": self.best_iteration,
                 "best_score": self.best_score,
-                "model_str": self.model_to_string()}
+                # ALL trees (num_iteration=-1): the default would truncate
+                # early-stopped boosters at best_iteration on pickling
+                "model_str": self.model_to_string(num_iteration=-1)}
 
     def __setstate__(self, state):
         self.params = state["params"]
@@ -551,9 +553,26 @@ class Booster:
         return out
 
     # ------------------------------------------------------------------
-    def predict(self, data, start_iteration: int = 0, num_iteration: int = -1,
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        # reference default: None -> best_iteration (all trees when no
+        # early stopping set one, since best_iteration is then -1)
+        if num_iteration is None:
+            num_iteration = self.best_iteration
+        if isinstance(data, str):
+            # predict straight from a data file (reference Booster.predict
+            # accepts a filename; role columns honored via params)
+            from .io.loader import load_file
+            data = load_file(data, Config.from_params(
+                dict(self.params or {}, **kwargs)))[0]
+            if data.ndim == 2 and data.shape[1] < self.num_feature():
+                # LibSVM width = max index SEEN; trailing all-zero
+                # features of the model may be absent from the file
+                data = np.pad(data,
+                              ((0, 0),
+                               (0, self.num_feature() - data.shape[1])))
         if hasattr(data, "values"):
             data = data.values
         from .io.dataset import _is_sparse
@@ -583,9 +602,11 @@ class Booster:
 
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0, importance_type: str = "split") -> str:
+        if num_iteration is None:
+            num_iteration = self.best_iteration      # reference default
         return model_io.save_model_to_string(
-            self._gbdt, num_iteration if num_iteration is not None else -1,
-            start_iteration, 1 if importance_type == "gain" else 0)
+            self._gbdt, num_iteration, start_iteration,
+            1 if importance_type == "gain" else 0)
 
     def dump_model(self, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> dict:

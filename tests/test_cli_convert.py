@@ -231,3 +231,16 @@ def test_column_roles_from_file(tmp_path):
                      "ignore_column": "name:junk", "min_data_in_leaf": 5},
                     lgb.Dataset(str(path)), num_boost_round=3)
     assert bst.num_trees() == 3
+
+
+def test_predict_from_file(cli_files, binary_data):
+    """Booster.predict accepts a data-file path (reference predict-on-file)."""
+    from lightgbm_tpu.application import main
+    d = cli_files
+    Xtr, ytr, Xte, yte = binary_data
+    if not (d / "model.txt").exists():     # order-independent
+        assert main([f"config={d / 'train.conf'}"]) == 0
+    bst = lgb.Booster(model_file=str(d / "model.txt"))
+    p_file = bst.predict(str(d / "binary.test"))
+    p_mem = bst.predict(Xte)
+    np.testing.assert_allclose(p_file, p_mem, rtol=1e-6)
