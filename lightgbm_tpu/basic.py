@@ -564,12 +564,17 @@ class Booster:
         if isinstance(data, str):
             # predict straight from a data file (reference Booster.predict
             # accepts a filename; role columns honored via params)
-            from .io.loader import load_file
+            from .io.loader import _detect_format, load_file
+            with open(data) as _fh:
+                fmt = _detect_format([_fh.readline() for _ in range(3)])
             data = load_file(data, Config.from_params(
                 dict(self.params or {}, **kwargs)))[0]
-            if data.ndim == 2 and data.shape[1] < self.num_feature():
-                # LibSVM width = max index SEEN; trailing all-zero
-                # features of the model may be absent from the file
+            if (fmt == "libsvm" and data.ndim == 2
+                    and data.shape[1] < self.num_feature()):
+                # ONLY LibSVM: its width is the max index SEEN, so trailing
+                # all-zero model features may be absent.  Dense formats
+                # must keep the shape check (a pad would silently mask a
+                # missing column as zeros)
                 data = np.pad(data,
                               ((0, 0),
                                (0, self.num_feature() - data.shape[1])))

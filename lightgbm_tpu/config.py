@@ -400,6 +400,13 @@ class Config:
 
         # (force_col_wise/force_row_wise conflict is checked below with the
         # other CheckParamConflict analogs)
+        if self.num_machines > 1 or self.machines:
+            Log.warning(
+                "machines/num_machines configure multi-PROCESS training: "
+                "bring the ranks up with parallel.set_network (machine "
+                "list) or parallel.init_distributed, then train with "
+                "parallel.train_distributed; a single process ignores "
+                "these fields")
         if self.histogram_pool_size >= 0:
             Log.info("histogram_pool_size is ignored: the dense device "
                      "histogram store has no LRU pool (HBM is the pool)")

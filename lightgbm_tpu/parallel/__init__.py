@@ -16,14 +16,15 @@ Multi-host bring-up (the reference's machine-list file + port handshake,
 ``linkers_socket.cpp``; Dask's cluster setup, ``python-package/lightgbm/
 dask.py``) is ``jax.distributed.initialize`` + the standard TPU pod runtime.
 """
-from .mesh import default_mesh, init_distributed
+from .mesh import default_mesh, free_network, init_distributed, set_network
 from ..io.distributed import distributed_dataset
 from .trainer import train_distributed
 from .data_parallel import make_dp_train_step, pad_rows_to_multiple, shard_rows
 from .feature_parallel import make_fp_train_step, pad_features_to_multiple
 from .voting_parallel import make_voting_train_step
 
-__all__ = ["default_mesh", "init_distributed", "distributed_dataset", "train_distributed",
+__all__ = ["default_mesh", "init_distributed", "set_network",
+           "free_network", "distributed_dataset", "train_distributed",
            "make_dp_train_step",
            "make_fp_train_step", "make_voting_train_step",
            "pad_rows_to_multiple", "pad_features_to_multiple", "shard_rows"]

@@ -46,10 +46,82 @@ def mesh_2d(num_data: int, num_feature: int,
 
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> None:
+                     process_id: Optional[int] = None,
+                     timeout_secs: Optional[int] = None) -> None:
     """Multi-host bring-up (replaces ``LGBM_NetworkInit`` + machine lists,
     ``c_api.cpp`` / ``application.cpp:167-202``).  On TPU pods all arguments
     are discovered from the environment."""
+    kw = {}
+    if timeout_secs is not None:
+        kw["initialization_timeout"] = int(timeout_secs)
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
-                               process_id=process_id)
+                               process_id=process_id, **kw)
+
+
+def set_network(machines, local_listen_port: int = 12400,
+                listen_time_out: int = 120,
+                num_machines: Optional[int] = None) -> None:
+    """Reference ``Booster.set_network`` analog: bring up the
+    ``jax.distributed`` client from a machine list.
+
+    ``machines`` is a list/set or a comma-separated string of
+    ``host[:port]`` entries — the FIRST entry becomes the coordinator
+    (the reference's rank-0 socket hub).  This process's rank is the
+    index of its entry, resolved by matching a local interface address
+    or hostname; pass ``host:port`` entries whose hosts are resolvable.
+    ``listen_time_out`` maps to the coordinator connect timeout.
+    """
+    import socket
+
+    if isinstance(machines, str):
+        entries = [m.strip() for m in machines.split(",") if m.strip()]
+    else:
+        entries = [str(m).strip() for m in machines]
+        if isinstance(machines, (set, frozenset)):
+            # per-process hash randomization would make each rank see a
+            # different entry order (different coordinator!) — sort for a
+            # deterministic shared view
+            entries = sorted(entries)
+    if num_machines is None:
+        num_machines = len(entries)
+    hosts = [e.split(":")[0] for e in entries]
+    coord_host = hosts[0]
+    coord_port = (int(entries[0].split(":")[1]) if ":" in entries[0]
+                  else local_listen_port)
+
+    local_names = {socket.gethostname(), "localhost", "127.0.0.1"}
+    try:
+        local_names.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    matches = []
+    for i, h in enumerate(hosts):
+        try:
+            addr = socket.gethostbyname(h)
+        except OSError:
+            addr = h
+        if h in local_names or addr in local_names:
+            matches.append(i)
+    if len(matches) > 1:
+        # same host listed multiple times (multi-process-per-box layout):
+        # hostname matching cannot tell the processes apart
+        raise ValueError(
+            f"set_network: machine entries {[entries[i] for i in matches]} "
+            "all resolve to this host; assign ranks explicitly with "
+            "init_distributed(coordinator_address, num_processes, "
+            "process_id)")
+    rank = matches[0] if matches else None
+    if rank is None:
+        raise ValueError(
+            f"set_network: none of the machine entries {hosts} resolves to "
+            "this host; use init_distributed(coordinator_address, "
+            "num_processes, process_id) to assign the rank explicitly")
+    init_distributed(coordinator_address=f"{coord_host}:{coord_port}",
+                     num_processes=num_machines, process_id=rank,
+                     timeout_secs=int(listen_time_out) * 60)  # ref: minutes
+
+
+def free_network() -> None:
+    """Reference ``LGBM_NetworkFree`` analog."""
+    jax.distributed.shutdown()

@@ -335,3 +335,34 @@ def test_two_process_valid_early_stopping(tmp_path):
     curves = {line.split("CURVE0 ")[1] for out in outs
               for line in out.splitlines() if "CURVE0" in line}
     assert len(rounds) == 1 and len(curves) == 1, outs
+
+
+_SETNET_WORKER = r"""
+import sys
+import numpy as np
+
+proc_id = int(sys.argv[1]); coord = sys.argv[2]
+sys.path.insert(0, "@REPO@")
+from lightgbm_tpu.parallel import set_network, free_network
+port = coord.split(":")[1]
+# both entries resolve to this host; rank disambiguation falls to the
+# FIRST matching entry, so proc 1 assigns explicitly via init_distributed
+if proc_id == 0:
+    set_network(f"127.0.0.1:{port},127.0.0.2:{port}")
+else:
+    from lightgbm_tpu.parallel import init_distributed
+    init_distributed(coordinator_address=coord, num_processes=2,
+                     process_id=1)
+import jax
+assert jax.process_count() == 2
+print("proc{} NETOK".format(proc_id))
+free_network()
+"""
+
+
+def test_set_network_brings_up_cluster(tmp_path):
+    """set_network (machine-list grammar) wires the jax.distributed client
+    (reference Booster.set_network / LGBM_NetworkInit analog)."""
+    outs = _run_two_procs(tmp_path, _SETNET_WORKER, timeout=240)
+    for pid, out in enumerate(outs):
+        assert f"proc{pid} NETOK" in out, out
