@@ -116,7 +116,9 @@ class Application:
             X, raw_score=cfg.predict_raw_score,
             pred_leaf=cfg.predict_leaf_index,
             pred_contrib=cfg.predict_contrib,
-            num_iteration=cfg.num_iteration_predict)
+            num_iteration=cfg.num_iteration_predict,
+            start_iteration=cfg.start_iteration_predict,
+            predict_disable_shape_check=cfg.predict_disable_shape_check)
         pred = np.atleast_1d(pred)
         with open(cfg.output_result, "w") as f:
             if pred.ndim == 1:

@@ -564,9 +564,8 @@ class Booster:
         if isinstance(data, str):
             # predict straight from a data file (reference Booster.predict
             # accepts a filename; role columns honored via params)
-            from .io.loader import _detect_format, load_file
-            with open(data) as _fh:
-                fmt = _detect_format([_fh.readline() for _ in range(3)])
+            from .io.loader import detect_file_format, load_file
+            fmt = detect_file_format(data)
             data = load_file(data, Config.from_params(
                 dict(self.params or {}, **kwargs)))[0]
             if (fmt == "libsvm" and data.ndim == 2
@@ -600,13 +599,19 @@ class Booster:
 
     # ------------------------------------------------------------------
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
-                   start_iteration: int = 0, importance_type: str = "split") -> "Booster":
+                   start_iteration: int = 0,
+                   importance_type: Optional[str] = None) -> "Booster":
         with open(filename, "w") as f:
             f.write(self.model_to_string(num_iteration, start_iteration, importance_type))
         return self
 
     def model_to_string(self, num_iteration: Optional[int] = None,
-                        start_iteration: int = 0, importance_type: str = "split") -> str:
+                        start_iteration: int = 0,
+                        importance_type: Optional[str] = None) -> str:
+        if importance_type is None:
+            # reference: saved_feature_importance_type picks the stored kind
+            importance_type = ("gain" if int(self.params.get(
+                "saved_feature_importance_type", 0)) == 1 else "split")
         if num_iteration is None:
             num_iteration = self.best_iteration      # reference default
         return model_io.save_model_to_string(

@@ -301,7 +301,6 @@ class Config:
     gpu_use_dp: bool = False
     num_gpu: int = 1
     # TPU-specific knobs (new in this framework):
-    hist_dtype: str = "float32"               # histogram accumulator dtype
     hist_chunk_rows: int = 8192               # rows per one-hot matmul chunk
     # adaptive leaf compaction: gather the smaller sibling's rows into the
     # tightest power-of-4 capacity bucket before histogramming, so per-split
@@ -314,7 +313,6 @@ class Config:
     hist_compact_ladder: float = 1.41
     mesh_shape: List[int] = field(default_factory=list)   # device mesh, [] = all devices on one axis
     pred_device: str = "auto"                 # auto | device | host ensemble predict
-    donate_state: bool = True
 
     # unknown keys seen during parsing (kept for model-file round trip)
     _unknown: Dict[str, Any] = field(default_factory=dict, repr=False)
@@ -407,6 +405,12 @@ class Config:
                 "list) or parallel.init_distributed, then train with "
                 "parallel.train_distributed; a single process ignores "
                 "these fields")
+        if self.two_round:
+            Log.info("two_round is ignored by design: ingest always streams "
+                     "through the double-buffered PipelineReader")
+        if self.is_enable_sparse is False:
+            Log.info("is_enable_sparse is ignored: sparse input is handled "
+                     "structurally (streamed block binning + EFB packing)")
         if self.histogram_pool_size >= 0:
             Log.info("histogram_pool_size is ignored: the dense device "
                      "histogram store has no LRU pool (HBM is the pool)")

@@ -222,10 +222,38 @@ class Dataset:
         mbf = cfg.max_bin_by_feature
         fb = mbf[f] if f < len(mbf) else cfg.max_bin
         bt = BinType.CATEGORICAL if f in cats else BinType.NUMERICAL
+        forced = self._forced_bin_bounds().get(f) if bt == BinType.NUMERICAL \
+            else None
         return BinMapper.find_bin(
             values, sample_cnt, fb, cfg.min_data_in_bin,
             cfg.min_data_in_leaf, cfg.feature_pre_filter, bin_type=bt,
-            use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing)
+            use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing,
+            forced_upper_bounds=forced)
+
+    def _forced_bin_bounds(self) -> Dict[int, List[float]]:
+        """forcedbins_filename JSON -> {feature: [bin_upper_bound, ...]}
+        (reference ``DatasetLoader::GetForcedBins``,
+        src/io/dataset_loader.cpp:1365; categorical features are skipped by
+        the caller)."""
+        cached = getattr(self, "_forced_bins_cache", None)
+        if cached is not None:
+            return cached
+        out: Dict[int, List[float]] = {}
+        path = self.config.forcedbins_filename
+        if path:
+            import json
+            try:
+                with open(path) as fh:
+                    arr = json.load(fh)
+                for item in arr:
+                    bounds = sorted(set(float(b)
+                                        for b in item["bin_upper_bound"]))
+                    out[int(item["feature"])] = bounds
+            except (OSError, ValueError, KeyError) as e:
+                Log.warning("Could not parse forcedbins file %s (%s); "
+                            "ignoring", path, e)
+        self._forced_bins_cache = out
+        return out
 
     def _finalize_used_features(self) -> None:
         self.used_features = [f for f, m in enumerate(self.bin_mappers)

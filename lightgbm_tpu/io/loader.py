@@ -30,15 +30,21 @@ def _detect_format(first_lines: List[str]) -> str:
     return "csv"
 
 
+def detect_file_format(path: str) -> str:
+    """csv/tsv/libsvm sniff of a data file's head (shared with
+    Booster.predict's file path)."""
+    with open(path) as f:
+        head = [f.readline() for _ in range(3)]
+    return _detect_format(head)
+
+
 def load_file(path: str, config: Optional[Config] = None):
     """Load a data file -> (features, label, feature_names, weight,
     group_sizes); the last two come from ``weight_column``/``group_column``
     (None otherwise)."""
     cfg = config or Config()
     check(os.path.exists(path), f"data file {path} does not exist")
-    with open(path) as f:
-        head = [f.readline() for _ in range(3)]
-    fmt = _detect_format(head)
+    fmt = detect_file_format(path)
     if fmt == "libsvm":
         feat, label, names = _load_libsvm(path)
         return feat, label, names, None, None

@@ -251,8 +251,10 @@ class GBDT:
             hist_compact_min_cap=cfg.hist_compact_min_cap,
             hist_compact_ladder=cfg.hist_compact_ladder,
             extra_trees=cfg.extra_trees,
+            extra_seed=cfg.extra_seed,
             sorted_cat=sorted_cat,
             bundle_bins=self._dd.bundle_bins,
+            monotone_penalty=cfg.monotone_penalty,
             monotone_mode=cfg.monotone_constraints_method,
             has_monotone=any(v != 0 for v in cfg.monotone_constraints))
 
@@ -401,6 +403,18 @@ class GBDT:
         def fn(raw, g, h, na, rw, feat_mat):
             return fit_leaf_linear(raw, g, h, na, rw, feat_mat, L, lam)
         return fn
+
+    def _feature_contri_vec(self):
+        """[F_inner] per-feature gain multipliers (reference
+        feature_contri -> FeatureMetainfo::penalty), or None."""
+        fc = self.config.feature_contri
+        if not fc:
+            return None
+        used = list(self.train_data.used_features)
+        if len(fc) < self.train_data.num_total_features:
+            raise LightGBMError(
+                "feature_contri should be the same size as feature number")
+        return jnp.asarray([fc[r] for r in used], jnp.float32)
 
     def _cegb_vectors(self):
         """(coupled[F_inner]|None, lazy[F_inner]|None), tradeoff-premultiplied."""
@@ -718,6 +732,7 @@ class GBDT:
         inter = self._interaction_sets()
         _, lazy = self._cegb_vectors()
         forced = self._forced_splits()
+        contri = self._feature_contri_vec()
         mesh = getattr(self, "_mesh", None)
 
         if mesh is None:
@@ -729,7 +744,8 @@ class GBDT:
                                  interaction_sets=inter,
                                  cegb_coupled=cegb_coupled,
                                  cegb_lazy=lazy, cegb_used_data=cegb_used,
-                                 forced=forced, efb=dd.efb)
+                                 forced=forced, efb=dd.efb,
+                                 feature_contri=contri)
             return fn
 
         # parallel learners: the same grow_tree program under shard_map, with
@@ -756,12 +772,14 @@ class GBDT:
                        if inter is not None else None)
             lazy_p = pad_i(lazy, 0.0) if lazy is not None else None
 
+            contri_p = (pad_i(contri, 1.0) if contri is not None else None)
+
             def grow(bins, g, h, rw, fmask, key, cc, cu):
                 return grow_tree(bins, g, h, rw, fmask, num_bins, default_bins,
                                  nan_bins, is_cat, mono, key, cfg,
                                  interaction_sets=inter_p, cegb_coupled=cc,
                                  cegb_lazy=lazy_p, cegb_used_data=cu,
-                                 forced=forced)
+                                 forced=forced, feature_contri=contri_p)
 
             sharded = jax.shard_map(
                 grow, mesh=mesh,
@@ -789,7 +807,8 @@ class GBDT:
                              dd.default_bins, dd.nan_bins, dd.is_categorical,
                              dd.monotone, key, cfg, interaction_sets=inter,
                              cegb_coupled=cc, cegb_lazy=lazy,
-                             cegb_used_data=cu, forced=forced, efb=dd.efb)
+                             cegb_used_data=cu, forced=forced, efb=dd.efb,
+                             feature_contri=contri)
 
         sharded = jax.shard_map(
             grow, mesh=mesh,

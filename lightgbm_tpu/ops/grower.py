@@ -136,6 +136,10 @@ class GrowerConfig(NamedTuple):
     # — when False the sorted-categorical scan is skipped at trace time,
     # removing ~128 sequential tiny ops + 4 argsorts from every split step
     sorted_cat: bool = True
+    extra_seed: int = 0       # extra-trees threshold stream (Config::extra_seed)
+    # depth-scaled gain penalty for splits on monotone features
+    # (reference ComputeMonotoneSplitGainPenalty)
+    monotone_penalty: float = 0.0
     # EFB (io/efb.py): histogram width of the BUNDLE columns the kernel sees;
     # 0 = bins are plain per-feature columns.  Feature-space histograms of
     # width max_bin are expanded from bundle space before each split search.
@@ -221,6 +225,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               cegb_used_data: "jax.Array | None" = None,
               forced: "Tuple[Tuple[int, int, int], ...]" = (),
               efb: "tuple | None" = None,
+              feature_contri: "jax.Array | None" = None,
               ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree.  Returns (tree, node_assignment[num_data]).
 
@@ -534,13 +539,32 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         """extra_trees: one random valid numeric threshold per (node, feature)."""
         if not cfg.extra_trees:
             return None
-        k = jax.random.fold_in(jax.random.fold_in(key, 7919), step)
+        # extra_seed decorrelates the threshold stream from every other
+        # seeded draw (reference Config::extra_seed)
+        k = jax.random.fold_in(
+            jax.random.fold_in(jax.random.fold_in(key, 7919), step),
+            cfg.extra_seed)
         hi = jnp.maximum(num_bins_l - 2 - (nan_bins_l >= 0), 0)
         u = jax.random.uniform(k, (num_bins_l.shape[0],))
         return jnp.floor(u * (hi + 1).astype(jnp.float32)).astype(jnp.int32)
 
+    def gain_mult_for(depth):
+        """[F] monotone-split penalty factor at a leaf of ``depth``
+        (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:355-364);
+        applied AFTER CEGB like the reference.  feature_contri flows
+        separately (BEFORE CEGB) via find()'s ``contri``."""
+        if not (cfg.has_monotone and cfg.monotone_penalty > 0.0):
+            return None
+        pen = cfg.monotone_penalty
+        d = jnp.asarray(depth, jnp.float32)
+        factor = jnp.where(
+            pen >= d + 1.0, 1e-15,
+            jnp.where(pen <= 1.0, 1.0 - pen / jnp.exp2(d),
+                      1.0 - jnp.exp2(pen - 1.0 - d)) + 1e-15)
+        return jnp.where(monotone != 0, factor, 1.0)
+
     def find(hist, sum_g, sum_h, count, fmask, parent_output=0.0,
-             lo=NEG_INF, hi=-NEG_INF, penalty=None, rand=None):
+             lo=NEG_INF, hi=-NEG_INF, penalty=None, rand=None, mult=None):
         """Mode-dispatched best-split search (the analog of the reference's
         learner-specific FindBestSplitsFromHistograms overrides)."""
         if mode == "feature" or dp_scatter:
@@ -552,25 +576,31 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 return jax.lax.dynamic_slice_in_dim(a, f_start, w)
             fmask_l = lsl(fmask)
             pen_l = lsl(penalty) if penalty is not None else None
+            mult_l = lsl(mult) if mult is not None else None
+            contri_l = (lsl(feature_contri) if feature_contri is not None
+                        else None)
             # rand_thresholds is built from num_bins_l: already shard-local
             s = find_best_split(hist, num_bins_l, default_bins_l, nan_bins_l,
                                 is_cat_l, mono_l, sum_g, sum_h, count, p,
                                 fmask_l, parent_output, lo, hi, pen_l, rand,
-                                sorted_cat=cfg.sorted_cat)
+                                sorted_cat=cfg.sorted_cat, gain_mult=mult_l,
+                                contri=contri_l)
             # local winner carries a shard-local feature id; globalize and
             # allreduce-max the packed SplitInfo (parallel_tree_learner.h:191)
             s = s._replace(feature=s.feature + f_start)
             return _reduce_split_global(s, axis)
         if mode == "voting":
             return _find_voting(hist, sum_g, sum_h, count, fmask,
-                                parent_output, lo, hi, penalty, rand)
+                                parent_output, lo, hi, penalty, rand,
+                                mult=mult)
         return find_best_split(hist, num_bins_l, default_bins_l, nan_bins_l,
                                is_cat_l, mono_l, sum_g, sum_h, count, p,
                                fmask, parent_output, lo, hi, penalty, rand,
-                               sorted_cat=cfg.sorted_cat)
+                               sorted_cat=cfg.sorted_cat, gain_mult=mult,
+                               contri=feature_contri)
 
     def _find_voting(hist, sum_g, sum_h, count, fmask, parent_output, lo, hi,
-                     penalty=None, rand=None):
+                     penalty=None, rand=None, mult=None):
         """Local top-k proposal → global vote → reduce only elected
         histograms (voting_parallel_tree_learner.cpp:151-345)."""
         # local gains with min-data/hessian gates scaled to the shard
@@ -582,7 +612,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         fg = per_feature_gains(hist, num_bins_l, nan_bins_l, is_cat_l, mono_l,
                                sum_g / ns, sum_h / ns, count / ns, p_loc,
                                fmask, parent_output, lo, hi,
-                               sorted_cat=cfg.sorted_cat)
+                               sorted_cat=cfg.sorted_cat, gain_mult=mult,
+                               contri=feature_contri)
         k = min(cfg.top_k, f_full)
         topv, topi = jax.lax.top_k(fg, k)
         votes = jnp.zeros(f_full, jnp.float32).at[topi].add(
@@ -599,7 +630,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return find_best_split(hist_e, num_bins_l, default_bins_l, nan_bins_l,
                                is_cat_l, mono_l, sum_g, sum_h, count, p,
                                emask, parent_output, lo, hi, penalty, rand,
-                               sorted_cat=cfg.sorted_cat)
+                               sorted_cat=cfg.sorted_cat, gain_mult=mult,
+                               contri=feature_contri)
 
     # monotone 'intermediate' (reference IntermediateLeafConstraints,
     # monotone_constraints.hpp:514): output bounds come from the ACTUAL
@@ -697,7 +729,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             jnp.zeros(f_full, bool) if cegb_coupled is not None else None,
             cegb_used_data)
     root_split = find(expand_hist(root_hist), tot[0], tot[1], tot[2], fmask0,
-                      penalty=pen0, rand=rand_thresholds(0))
+                      penalty=pen0, rand=rand_thresholds(0),
+                      mult=gain_mult_for(0))
 
     # histogram store stays in BUNDLE space (subtraction is linear there);
     # searches expand to feature space on the fly.  Under dp_scatter each
@@ -1089,16 +1122,18 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         if use_cegb:
             pen2 = jnp.stack([cegb_penalty(lmask, c2[0], feat_used, used_data),
                               cegb_penalty(rmask, c2[1], feat_used, used_data)])
+        mult2 = gain_mult_for(depth)        # both children share the depth
+        if use_cegb:
             s2 = jax.vmap(
                 lambda hc, g_, h_, c_, lo_, hi_, pen_: find(
                     expand_hist(hc), g_, h_, c_, fmask, 0.0, lo_, hi_,
-                    penalty=pen_, rand=rand)
+                    penalty=pen_, rand=rand, mult=mult2)
             )(hist2, g2, h2, c2, lo2, hi2, pen2)
         else:
             s2 = jax.vmap(
                 lambda hc, g_, h_, c_, lo_, hi_: find(
                     expand_hist(hc), g_, h_, c_, fmask, 0.0, lo_, hi_,
-                    rand=rand)
+                    rand=rand, mult=mult2)
             )(hist2, g2, h2, c2, lo2, hi2)
         s2 = s2._replace(gain=jnp.where(depth_ok, s2.gain, NEG_INF))
         sl = jax.tree.map(lambda a: a[0], s2)
@@ -1212,7 +1247,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                      st["leaf_weight"][leaf], st["leaf_count"][leaf],
                      fmask_j, 0.0,
                      st["leaf_lo"][leaf], st["leaf_hi"][leaf],
-                     penalty=pen_j, rand=rand_thresholds(step0))
+                     penalty=pen_j, rand=rand_thresholds(step0),
+                     mult=gain_mult_for(st["leaf_depth"][leaf]))
         depth_ok = (cfg.max_depth <= 0) | (st["leaf_depth"][leaf]
                                            < cfg.max_depth)
         s_new = s_new._replace(gain=jnp.where(depth_ok, s_new.gain, NEG_INF))

@@ -105,7 +105,7 @@ def leaf_gain(sum_g, sum_h, p: SplitParams, parent_output=0.0, count=None,
 def _split_gain_matrix(hist, num_bins, nan_bins, is_categorical, monotone,
                        total, p: SplitParams, feature_mask,
                        parent_output, output_lo, output_hi,
-                       gain_penalty=None, rand_threshold=None):
+                       gain_penalty=None, rand_threshold=None, contri=None):
     """Candidate gains over all (feature, threshold) pairs.
 
     Returns (gain_fb [F, B], use_left [F, B], cum [F, B, 3], miss [F, 3]).
@@ -155,6 +155,16 @@ def _split_gain_matrix(hist, num_bins, nan_bins, is_categorical, monotone,
                                  extra_l2=p.cat_l2)
     is_cat = is_categorical[:, None]
     gain_fb = jnp.where(is_cat, cat_gain, num_gain)                    # [F, B]
+    if contri is not None:
+        # feature_contri scales the min_gain-shifted improvement BEFORE the
+        # CEGB delta-gain is subtracted (reference order: FindBestThreshold
+        # applies meta_->penalty internally, feature_histogram.hpp:94, and
+        # serial_tree_learner.cpp:740 subtracts CEGB after)
+        pivot = leaf_gain(total[0], total[1], p, parent_output, total[2],
+                          output_lo, output_hi) + p.min_gain_to_split
+        gain_fb = jnp.where(gain_fb > NEG_INF / 2,
+                            pivot + (gain_fb - pivot) * contri[:, None],
+                            gain_fb)
     if gain_penalty is not None:
         # CEGB: per-feature penalty subtracted from the candidate gain before
         # the argmax (reference ``new_split.gain -= cegb_->DetlaGain(...)``,
@@ -197,7 +207,7 @@ def bitset_contains(bits: jax.Array, idx: jax.Array) -> jax.Array:
 
 def _sorted_cat_best(hist, num_bins, is_categorical, monotone, total,
                      p: SplitParams, feature_mask, parent_output,
-                     output_lo, output_hi, gain_penalty=None):
+                     output_lo, output_hi, gain_penalty=None, contri=None):
     """Sorted many-category split scan, vectorized over features.
 
     Reference ``FindBestThresholdCategoricalInner`` sorted branch
@@ -258,10 +268,16 @@ def _sorted_cat_best(hist, num_bins, is_categorical, monotone, total,
             ro_out = leaf_output(rg, rh, p_eff, parent_output, rc,
                                  output_lo, output_hi)
             bad = ((mono > 0) & (lo_out > ro_out)) | ((mono < 0) & (lo_out < ro_out))
-            gain = (leaf_gain(lg, lh, p_eff, parent_output, lc,
-                              output_lo, output_hi)
-                    + leaf_gain(rg, rh, p_eff, parent_output, rc,
-                                output_lo, output_hi)) - pen
+            raw = (leaf_gain(lg, lh, p_eff, parent_output, lc,
+                             output_lo, output_hi)
+                   + leaf_gain(rg, rh, p_eff, parent_output, rc,
+                               output_lo, output_hi))
+            if contri is not None:
+                pivot = leaf_gain(total[0], total[1], p, parent_output,
+                                  total[2], output_lo, output_hi) \
+                    + p.min_gain_to_split
+                raw = pivot + (raw - pivot) * contri
+            gain = raw - pen
             gain = jnp.where(considered & ~bad, gain, NEG_INF)
             better = gain > best_gain
             return (cnt_grp,
@@ -291,20 +307,29 @@ def _sorted_cat_best(hist, num_bins, is_categorical, monotone, total,
 def per_feature_gains(hist, num_bins, nan_bins, is_categorical, monotone,
                       sum_g, sum_h, count, p: SplitParams, feature_mask,
                       parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF,
-                      sorted_cat: bool = True) -> jax.Array:
+                      sorted_cat: bool = True, gain_mult=None,
+                      contri=None) -> jax.Array:
     """Best candidate gain per feature — ``[F]``.  Used by the voting-parallel
     learner's local top-k proposal (reference ``VotingParallelTreeLearner``,
-    ``voting_parallel_tree_learner.cpp:151``)."""
+    ``voting_parallel_tree_learner.cpp:151``).  Penalty-aware: the election
+    must rank features by PENALIZED gains (the reference votes on
+    SplitInfo gains that already include FeatureMetainfo::penalty), else a
+    muted feature could crowd the elected set."""
     total = jnp.stack([sum_g, sum_h, count]).astype(jnp.float32)
     gain_fb, _, _, _ = _split_gain_matrix(
         hist, num_bins, nan_bins, is_categorical, monotone, total, p,
-        feature_mask, parent_output, output_lo, output_hi)
+        feature_mask, parent_output, output_lo, output_hi, contri=contri)
     best = jnp.max(gain_fb, axis=1)
     if sorted_cat:
         gain_sorted, _, _ = _sorted_cat_best(
             hist, num_bins, is_categorical, monotone, total, p, feature_mask,
-            parent_output, output_lo, output_hi)
+            parent_output, output_lo, output_hi, contri=contri)
         best = jnp.maximum(best, gain_sorted)
+    if gain_mult is not None:
+        pivot = leaf_gain(total[0], total[1], p, parent_output, total[2],
+                          output_lo, output_hi) + p.min_gain_to_split
+        best = jnp.where(best > NEG_INF / 2,
+                         pivot + (best - pivot) * gain_mult, best)
     return best
 
 
@@ -314,7 +339,8 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
                     p: SplitParams, feature_mask: jax.Array,
                     parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF,
                     gain_penalty=None, rand_threshold=None,
-                    sorted_cat: bool = True) -> SplitResult:
+                    sorted_cat: bool = True, gain_mult=None,
+                    contri=None) -> SplitResult:
     """Find the best split of a leaf given its histogram.
 
     Args:
@@ -331,11 +357,12 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
     gain_fb, use_left, cum, miss = _split_gain_matrix(
         hist, num_bins, nan_bins, is_categorical, monotone, total, p,
         feature_mask, parent_output, output_lo, output_hi, gain_penalty,
-        rand_threshold)
+        rand_threshold, contri=contri)
     if sorted_cat:
         gain_sorted, bits_sorted, left_sorted = _sorted_cat_best(
             hist, num_bins, is_categorical, monotone, total, p, feature_mask,
-            parent_output, output_lo, output_hi, gain_penalty)
+            parent_output, output_lo, output_hi, gain_penalty,
+            contri=contri)
     else:
         # statically no many-category feature in the dataset: the sorted scan
         # (2 argsorts + 2 maxT-step fori_loops of tiny ops) is pure per-split
@@ -343,6 +370,22 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
         gain_sorted = jnp.full(max(f, 1), NEG_INF, jnp.float32)
         bits_sorted = jnp.zeros((max(f, 1), cw), jnp.int32)
         left_sorted = jnp.zeros((max(f, 1), 3), jnp.float32)
+
+    if gain_mult is not None:
+        # monotone split penalty (ComputeMonotoneSplitGainPenalty,
+        # monotone_constraints.hpp:355) scales the min_gain-shifted
+        # improvement AFTER any CEGB subtraction (serial_tree_learner.cpp:
+        # 745-749); rebasing around parent_gain + min_gain makes the final
+        # ``best - parent - min_gain`` exactly the reference's scaled gain
+        pivot = leaf_gain(total[0], total[1], p, parent_output, total[2],
+                          output_lo, output_hi) + p.min_gain_to_split
+        gain_fb = jnp.where(gain_fb > NEG_INF / 2,
+                            pivot + (gain_fb - pivot) * gain_mult[:, None],
+                            gain_fb)
+        if sorted_cat:
+            gain_sorted = jnp.where(
+                gain_sorted > NEG_INF / 2,
+                pivot + (gain_sorted - pivot) * gain_mult, gain_sorted)
 
     # --- argmax over (feature, threshold) ------------------------------------
     flat = gain_fb.reshape(-1)

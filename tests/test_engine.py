@@ -959,3 +959,71 @@ def test_cv_early_stopping_callback(binary_data):
     cvb = res["cvbooster"]
     assert 0 < cvb.best_iteration <= 60
     assert len(res["valid binary_logloss-mean"]) == cvb.best_iteration
+
+
+def test_feature_contri_steers_splits(binary_data):
+    """feature_contri multiplies per-feature split improvements (reference
+    FeatureMetainfo::penalty, feature_histogram.hpp:94): zeroing a feature's
+    contribution keeps it out of the tree; boosting it pulls it in."""
+    Xtr, ytr, _, _ = binary_data
+    f = Xtr.shape[1]
+    base = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                     lgb.Dataset(Xtr, label=ytr), num_boost_round=5)
+    top = int(np.argmax(base.feature_importance("split")))
+    contri = [1.0] * f
+    contri[top] = 0.0
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "feature_contri": contri}
+    muted = lgb.train(params, lgb.Dataset(Xtr, label=ytr, params=params),
+                      num_boost_round=5)
+    assert muted.feature_importance("split")[top] == 0
+
+
+def test_monotone_penalty_discourages_shallow_monotone_splits(regression_data):
+    """monotone_penalty scales down monotone-feature gains near the root
+    (ComputeMonotoneSplitGainPenalty); a strong penalty forbids monotone
+    splits above depth penalty-1 entirely."""
+    X, y = regression_data[0], regression_data[1]
+    f = X.shape[1]
+    params = {"objective": "regression", "num_leaves": 31, "verbose": -1,
+              "monotone_constraints": [1] + [0] * (f - 1),
+              "max_depth": 3}
+    plain = lgb.train(params, lgb.Dataset(X, label=y, params=params), 5)
+    pen_params = dict(params, monotone_penalty=4.0)   # >= max_depth + 1
+    pen = lgb.train(pen_params,
+                    lgb.Dataset(X, label=y, params=pen_params), 5)
+    # depth <= 3 everywhere and penalty >= depth+1 -> feature 0 never splits
+    assert pen.feature_importance("split")[0] == 0
+    assert plain.feature_importance("split")[0] > 0
+    # monotonicity still holds for the penalized model
+    base = np.median(X, axis=0)
+    grid = np.tile(base, (40, 1)); grid[:, 0] = np.linspace(-2, 2, 40)
+    assert np.all(np.diff(pen.predict(grid)) >= -1e-9)
+
+
+def test_forcedbins_file(tmp_path, binary_data):
+    """forcedbins_filename pins bin upper bounds (reference GetForcedBins,
+    dataset_loader.cpp:1365)."""
+    import json
+    Xtr, ytr, _, _ = binary_data
+    fb = tmp_path / "bins.json"
+    fb.write_text(json.dumps([{"feature": 0,
+                               "bin_upper_bound": [-0.5, 0.0, 0.5]}]))
+    params = {"max_bin": 15, "min_data_in_bin": 1,
+              "forcedbins_filename": str(fb)}
+    ds = lgb.Dataset(Xtr, label=ytr, params=params)
+    ds.construct()
+    ub = list(ds._inner.bin_mappers[0].bin_upper_bound)
+    for b in (-0.5, 0.0, 0.5):
+        assert any(abs(u - b) < 1e-9 for u in ub), (b, ub)
+
+
+def test_extra_seed_changes_extra_trees(binary_data):
+    Xtr, ytr, _, _ = binary_data
+    def tr(seed):
+        p = {"objective": "binary", "extra_trees": True, "num_leaves": 15,
+             "verbose": -1, "seed": 1, "extra_seed": seed}
+        return lgb.train(p, lgb.Dataset(Xtr, label=ytr, params=p), 3)
+    a, b, c = tr(1), tr(2), tr(1)
+    assert a.model_to_string() == c.model_to_string()
+    assert a.model_to_string() != b.model_to_string()
