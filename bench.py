@@ -33,22 +33,49 @@ def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 42):
     return X, y
 
 
-def probe_backend(timeout: float = 300.0) -> bool:
-    """True when the ambient backend answers a trivial matmul within
-    ``timeout`` seconds, probed in a SUBPROCESS (a wedged axon tunnel hangs
-    rather than errors).  Shared by the bench fallback and
-    scripts/tpu_perf_suite.py."""
+def probe_backend(timeout: float = 300.0, count_devices: bool = False):
+    """Probe the ambient backend in a SUBPROCESS (a wedged axon tunnel hangs
+    rather than errors): run a trivial matmul and count devices.  Returns
+    bool liveness, or the device count (0 = dead) when ``count_devices``.
+    Shared by the bench fallback, scripts/tpu_perf_suite.py, and
+    __graft_entry__.dryrun_multichip.
+
+    Hardened against the wedge itself: the child runs in its own process
+    group (killpg on timeout reaches any tunnel helper it forked) and writes
+    to a temp file, not a pipe, so a surviving grandchild holding the pipe
+    can't block us after the kill."""
+    import signal
     import subprocess
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp;"
-             "(jnp.ones((64,64)) @ jnp.ones((64,64))).block_until_ready();"
-             "print('live')"],
-            timeout=timeout, capture_output=True, text=True)
-        return "live" in (r.stdout or "")
-    except subprocess.TimeoutExpired:
-        return False
+    import tempfile
+    code = ("import jax, jax.numpy as jnp;"
+            "(jnp.ones((64,64)) @ jnp.ones((64,64))).block_until_ready();"
+            "print('ndev=%d' % len(jax.devices()))")
+    with tempfile.TemporaryFile(mode="w+") as out:
+        p = subprocess.Popen([sys.executable, "-c", code], stdout=out,
+                             stderr=subprocess.DEVNULL,
+                             start_new_session=True)
+        try:
+            p.wait(timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                p.wait(5)
+            except subprocess.TimeoutExpired:
+                pass            # unreapable (D-state) child: give up, move on
+            return 0 if count_devices else False
+        out.seek(0)
+        txt = out.read()
+    ndev = 0
+    for tok in txt.split():
+        if tok.startswith("ndev="):
+            try:
+                ndev = int(tok[5:])
+            except ValueError:
+                pass
+    return ndev if count_devices else ndev > 0
 
 
 def _ensure_live_backend() -> bool:
@@ -69,7 +96,7 @@ def _ensure_live_backend() -> bool:
     env["JAX_PLATFORMS"] = "cpu"
     bench_dir = os.path.dirname(os.path.abspath(__file__))
     prev_pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-               if p and "axon" not in p]
+               if p and "axon_site" not in p]
     env["PYTHONPATH"] = os.pathsep.join([bench_dir] + prev_pp)
     env["_BENCH_REEXEC"] = "tpu_unreachable"
     env.setdefault("BENCH_ROWS", "200000")      # CPU fallback: keep it sane
