@@ -489,8 +489,9 @@ class Booster:
         self._network_initialized = False
         self._load_from_string(state["model_str"])
 
-    @property
     def current_iteration(self) -> int:
+        """Number of completed iterations (reference
+        ``Booster.current_iteration()`` — a method, not a property)."""
         return self._gbdt.iter_
 
     def num_trees(self) -> int:
@@ -536,21 +537,30 @@ class Booster:
         else:
             all_results = self._gbdt.eval_current()
             out = [(n, m, v, h) for (n, m, v, h) in all_results if n == name]
-        if feval is not None:
-            if idx < 0:
-                score = np.asarray(self._gbdt._train_score, np.float64)
-                dataset = self.train_set
-            else:
-                score = np.asarray(self._gbdt._valid_scores[idx], np.float64)
-                dataset = (self.valid_sets_py[idx]
-                           if getattr(self, "valid_sets_py", None) else None)
-            s = score[0] if self._gbdt.num_tree_per_iteration == 1 else score
-            res = feval(s, dataset)
-            if isinstance(res, tuple):
-                res = [res]
-            for mname, val, hib in res:
-                out.append((name, mname, val, hib))
+        out.extend(self._feval_results(name, idx, feval))
         return out
+
+    def _feval_results(self, name, idx, feval):
+        """feval-only rows for one eval set (idx -1 = training), no
+        builtin metrics — lets the train loop add feval results without
+        re-running every builtin metric per valid set."""
+        if feval is None:
+            return []
+        if idx < 0:
+            # boosters loaded from model text have no training score
+            if self._gbdt._train_score is None:
+                return []
+            score = np.asarray(self._gbdt._train_score, np.float64)
+            dataset = self.train_set
+        else:
+            score = np.asarray(self._gbdt._valid_scores[idx], np.float64)
+            dataset = (self.valid_sets_py[idx]
+                       if getattr(self, "valid_sets_py", None) else None)
+        s = score[0] if self._gbdt.num_tree_per_iteration == 1 else score
+        res = feval(s, dataset)
+        if isinstance(res, tuple):
+            res = [res]
+        return [(name, mname, val, hib) for mname, val, hib in res]
 
     # ------------------------------------------------------------------
     def predict(self, data, start_iteration: int = 0,

@@ -123,6 +123,11 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             _init(env)
         if not enabled[0]:
             return
+        # CVBooster's __getattr__ fabricates a handler for any attribute, so
+        # only trust a real string here (cv's train rows are the cv_agg case)
+        train_name = getattr(env.model, "_train_data_name", "training")
+        if not isinstance(train_name, str):
+            train_name = "training"
         for i, entry in enumerate(env.evaluation_result_list):
             name, metric, score = entry[0], entry[1], entry[2]
             if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
@@ -131,8 +136,8 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                 best_score_list[i] = env.evaluation_result_list
             if first_metric_only and first_metric[0] != metric.split(" ")[-1]:
                 continue
-            if name == "training" or (name == "cv_agg"
-                                      and metric.startswith("train")):
+            if (name == "training" or name == train_name
+                    or (name == "cv_agg" and metric.startswith("train"))):
                 continue
             if env.iteration - best_iter[i] >= stopping_rounds:
                 if verbose:

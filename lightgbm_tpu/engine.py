@@ -38,12 +38,21 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         params["objective"] = "none"
 
     booster = Booster(params=params, train_set=train_set)
+    contains_train = False
     if valid_sets:
+        user_named = valid_names is not None
         valid_names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
         for vs, name in zip(valid_sets, valid_names):
             if vs is train_set:
+                # reference engine.py: a user-supplied name for the train set
+                # renames it everywhere (eval output AND the early-stopping
+                # skip, which compares against _train_data_name); the train
+                # set is NOT an eval_valid entry — name_valid_sets must stay
+                # index-aligned with the gbdt's valid sets
+                contains_train = True
                 booster._gbdt.config.is_provide_training_metric = True
-                booster.name_valid_sets.append("training")
+                if user_named:
+                    booster.set_train_data_name(name)
                 continue
             booster.add_valid(vs, name)
 
@@ -67,6 +76,7 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     snapshot_freq = booster._gbdt.config.snapshot_freq
+    evaluation_result_list = []         # stays [] when num_boost_round == 0
     for i in range(num_boost_round):
         for cb in cbs_before:
             cb(callback_mod.CallbackEnv(booster, params, i, 0, num_boost_round, None))
@@ -81,7 +91,16 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         if booster._gbdt.valid_sets or booster._gbdt.config.is_provide_training_metric:
             evaluation_result_list = booster._gbdt.eval_current()
         if feval is not None:
-            evaluation_result_list.extend(booster.eval_valid(feval))
+            # feval-only rows: builtins are already in the list via
+            # eval_current, so re-running them per valid set (and once more
+            # for a train set inside valid_sets) would emit duplicates
+            if contains_train:
+                evaluation_result_list.extend(booster._feval_results(
+                    getattr(booster, "_train_data_name", "training"), -1,
+                    feval))
+            for vi, vname in enumerate(booster.name_valid_sets):
+                evaluation_result_list.extend(
+                    booster._feval_results(vname, vi, feval))
         try:
             for cb in cbs_after:
                 cb(callback_mod.CallbackEnv(booster, params, i, 0, num_boost_round,

@@ -411,7 +411,7 @@ class GBDT:
         if not fc:
             return None
         used = list(self.train_data.used_features)
-        if len(fc) < self.train_data.num_total_features:
+        if len(fc) != self.train_data.num_total_features:
             raise LightGBMError(
                 "feature_contri should be the same size as feature number")
         return jnp.asarray([fc[r] for r in used], jnp.float32)

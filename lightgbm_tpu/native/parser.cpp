@@ -118,14 +118,23 @@ class PipelineReader {
       fill(front_);
       started_ = true;
     } else {
-      io_.join();
+      if (io_.joinable()) io_.join();   // no thread after a short-read skip
       front_ ^= 1;              // the prefetched buffer becomes current
     }
-    if (len_[front_] == 0) return false;
+    if (len_[front_] == 0) {
+      len_[front_ ^ 1] = 0;     // EOF is sticky: further acquires stay false
+      return false;
+    }
     *data = buf_[front_].data();
     *n = len_[front_];
-    int back = front_ ^ 1;
-    io_ = std::thread([this, back] { fill(back); });
+    // a short read means EOF was reached: the prefetch would only perform a
+    // guaranteed zero-byte fread, so don't spawn it
+    if (len_[front_] == section_) {
+      int back = front_ ^ 1;
+      io_ = std::thread([this, back] { fill(back); });
+    } else {
+      len_[front_ ^ 1] = 0;
+    }
     return true;
   }
 

@@ -137,3 +137,43 @@ def test_set_train_data_name(binary_data):
     bst.set_train_data_name("my_training")
     names = [r[0] for r in bst.eval_train()]
     assert names and all(n == "my_training" for n in names)
+
+def test_early_stopping_skips_renamed_training_set(binary_data):
+    # advisor r3: with train_set in valid_sets under a custom name, early
+    # stopping must not trigger on the training metric (reference compares
+    # against the booster's _train_data_name, not the literal 'training')
+    Xtr, ytr, Xte, yte = binary_data
+    train = lgb.Dataset(Xtr, label=ytr)
+    bst = lgb.train(
+        {"objective": "binary", "metric": "binary_logloss", "verbose": -1,
+         "num_leaves": 31},
+        train, num_boost_round=30,
+        valid_sets=[train], valid_names=["my_train"],
+        callbacks=[lgb.early_stopping(stopping_rounds=2, verbose=False)])
+    # training logloss monotonically improves, so without the skip the
+    # callback would never stop -- but with only the training set present
+    # it must ALSO never raise mid-run; all 30 rounds complete
+    assert bst.current_iteration() == 30
+
+
+def test_eval_train_feval_on_loaded_booster(small_model, binary_data):
+    # advisor r3: eval_train(feval) on a booster loaded from a model string
+    # has no training score; must return [] (not crash on np.asarray(None))
+    bst, _ = small_model
+    clone = lgb.Booster(model_str=bst.model_to_string())
+
+    def feval(preds, dataset):
+        return "const", 1.0, True
+
+    assert clone.eval_train(feval=feval) == []
+
+
+def test_feature_contri_exact_length_required(binary_data):
+    # advisor r3: an over-long feature_contri list must be rejected, like
+    # the reference's exact-size check
+    Xtr, ytr, _, _ = binary_data
+    n_feat = Xtr.shape[1]
+    with pytest.raises(Exception, match="feature_contri"):
+        lgb.train({"objective": "binary", "verbose": -1,
+                   "feature_contri": [1.0] * (n_feat + 3)},
+                  lgb.Dataset(Xtr, label=ytr), num_boost_round=1)
