@@ -19,7 +19,7 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Union
 
-from .utils.log import Log, check
+from .utils.log import LightGBMError, Log, check
 
 # ---------------------------------------------------------------------------
 # Alias table (reference: src/io/config_auto.cpp:10-168). Maps alias -> canonical.
@@ -311,6 +311,12 @@ class Config:
     # bucket growth factor (>= 1.2): 1.41 benched ~10% faster trees than 2
     # on v5e (half the round-up waste) for ~30% more compile time
     hist_compact_ladder: float = 1.41
+    # round-batched best-first growth (ops/frontier.py): auto | serial |
+    # frontier.  'auto' batches whenever the feature set is order-decoupled
+    # (no monotone/CEGB/interaction/forced/extra-trees/per-node sampling)
+    tree_grower: str = "auto"
+    frontier_k: int = 16                      # leaves expanded per round
+    frontier_block_rows: int = 512            # kernel rows/block (128-mult)
     mesh_shape: List[int] = field(default_factory=list)   # device mesh, [] = all devices on one axis
     pred_device: str = "auto"                 # auto | device | host ensemble predict
 
@@ -395,6 +401,18 @@ class Config:
                      "refit_tree": "refit"}.get(self.task.lower(), self.task.lower())
 
         self.monotone_constraints_method = self.monotone_constraints_method.lower()
+
+        self.tree_grower = self.tree_grower.lower()
+        if self.tree_grower not in ("auto", "serial", "frontier"):
+            raise LightGBMError(
+                f"tree_grower must be auto/serial/frontier, got "
+                f"'{self.tree_grower}'")
+        if self.frontier_k < 1:
+            raise LightGBMError("frontier_k must be >= 1")
+        if self.frontier_block_rows < 128 or self.frontier_block_rows % 128:
+            raise LightGBMError(
+                "frontier_block_rows must be a 128-multiple >= 128 "
+                "(the Pallas kernel's row-block tiling)")
 
         # (force_col_wise/force_row_wise conflict is checked below with the
         # other CheckParamConflict analogs)

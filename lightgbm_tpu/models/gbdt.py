@@ -256,7 +256,10 @@ class GBDT:
             bundle_bins=self._dd.bundle_bins,
             monotone_penalty=cfg.monotone_penalty,
             monotone_mode=cfg.monotone_constraints_method,
-            has_monotone=any(v != 0 for v in cfg.monotone_constraints))
+            has_monotone=any(v != 0 for v in cfg.monotone_constraints),
+            grower_mode=cfg.tree_grower,
+            frontier_k=cfg.frontier_k,
+            frontier_block_rows=cfg.frontier_block_rows)
 
     # ------------------------------------------------------------------
     # feature-gating state: interaction constraints + CEGB (SURVEY.md §2.4)

@@ -153,6 +153,13 @@ class GrowerConfig(NamedTuple):
     # of the machinery).
     monotone_mode: str = "basic"
     has_monotone: bool = False
+    # round-batched best-first growth (ops/frontier.py): 'auto' takes the
+    # frontier grower whenever the feature set allows (see
+    # _frontier_eligible), 'serial' forces the one-split-at-a-time loop,
+    # 'frontier' asks for batching and warns+falls back when ineligible
+    grower_mode: str = "auto"
+    frontier_k: int = 16          # leaves expanded per round
+    frontier_block_rows: int = 512  # rows per kernel block (128-multiple)
 
 
 class TreeArrays(NamedTuple):
@@ -214,6 +221,37 @@ class _BestSplits(NamedTuple):
             cat_bits=u(self.cat_bits, s.cat_bits))
 
 
+def _frontier_eligible(cfg: "GrowerConfig", n_cols: int, interaction_sets,
+                       cegb_coupled, cegb_lazy, forced) -> bool:
+    """True when the round-batched frontier grower (ops/frontier.py) can
+    serve this call.  Cross-leaf-coupled features (monotone bounds, CEGB
+    refunds, interaction branch masks, forced-split prefixes) and
+    split-step-keyed RNG (per-node feature sampling, extra-trees) depend on
+    the sequential split order and take the one-split loop."""
+    if cfg.grower_mode == "serial":
+        return False
+    mode = cfg.parallel_mode or ("data" if cfg.axis_name is not None else None)
+    ok = (not cfg.has_monotone
+          and interaction_sets is None
+          and cegb_coupled is None and cegb_lazy is None
+          and not forced
+          and not cfg.extra_trees
+          and cfg.feature_fraction_bynode >= 1.0
+          and cfg.cegb_split_penalty == 0.0
+          and mode in (None, "data"))
+    if ok and cfg.hist_method == "pallas":
+        # the batched kernel only has the row-major layout; very wide
+        # feature blocks exceed its lane budget
+        from .histogram import _PALLAS_ROWMAJOR_MAX_LANES
+        bb = cfg.bundle_bins or cfg.max_bin
+        ok = n_cols * (-(-bb // 128) * 128) <= _PALLAS_ROWMAJOR_MAX_LANES
+    if not ok and cfg.grower_mode == "frontier":
+        from ..utils.log import Log
+        Log.warning("tree_grower=frontier is not compatible with the "
+                    "requested features; using the serial grower")
+    return ok
+
+
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               row_weight: jax.Array, feature_mask: jax.Array,
               num_bins: jax.Array, default_bins: jax.Array, nan_bins: jax.Array,
@@ -252,6 +290,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         for each split search; the split column decodes through the uniform
         ``col - off + 1`` mapping (identity for singleton bundles).
     """
+    if _frontier_eligible(cfg, bins.shape[1], interaction_sets,
+                          cegb_coupled, cegb_lazy, forced):
+        from .frontier import grow_tree_frontier
+        return grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
+                                  num_bins, default_bins, nan_bins,
+                                  is_categorical, monotone, key, cfg,
+                                  efb=efb, feature_contri=feature_contri)
     n, n_cols = bins.shape
     if efb is not None:
         efb_bundle_np, efb_off_np, efb_nb_np = efb

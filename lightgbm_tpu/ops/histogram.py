@@ -109,6 +109,102 @@ def _hist_onehot(bins, grad, hess, mask, max_bin, chunk_rows):
     return hist.reshape(3, f, max_bin).transpose(1, 2, 0)
 
 
+def build_histogram_leaves(comb: jax.Array, grad: jax.Array, hess: jax.Array,
+                           mask: jax.Array, block_leaf: jax.Array,
+                           num_slots: int, max_bin: int, *,
+                           method: str = "onehot", block_rows: int = 512,
+                           f_limit: "int | None" = None) -> jax.Array:
+    """Per-leaf histograms of leaf-grouped row blocks — the frontier grower's
+    batched analog of ``build_histogram``.
+
+    ``comb`` is ``[C, NC]`` gathered rows laid out as consecutive
+    ``block_rows``-sized blocks, each block belonging to ONE leaf slot
+    (``block_leaf[C // block_rows]`` i32, sorted ascending); padded rows
+    carry ``mask == 0``.  Returns ``[num_slots, F, B, 3]`` where
+    ``F = f_limit or NC`` (the XLA fallback returns all NC columns, trailing
+    packed-gradient columns as garbage for the caller to slice).
+
+    The Pallas path re-uses the row-major one-hot MXU kernel with the output
+    block index scalar-prefetched from ``block_leaf`` — same-leaf blocks are
+    consecutive, so each ``[6, F*Bp]`` leaf histogram stays VMEM-resident
+    across its row blocks and flushes once (the reference GPU kernels'
+    per-workgroup shared-memory accumulation, ``histogram256.cl:100``,
+    with the workgroup->leaf map replacing the workgroup->feature-group map).
+    """
+    n, nc = comb.shape
+    f = min(f_limit, nc) if f_limit is not None else nc
+    if method == "pallas" and f * (-(-max_bin // 128) * 128) <= \
+            _PALLAS_ROWMAJOR_MAX_LANES:
+        return _hist_leaves_pallas(comb, grad, hess, mask, block_leaf,
+                                   num_slots, max_bin, block_rows, f)
+    # XLA fallback: one scatter-add with the leaf slot folded into the flat
+    # bin index (fast on CPU, correct everywhere)
+    row_leaf = jnp.repeat(block_leaf, block_rows, total_repeat_length=n)
+    gh = jnp.stack([grad * mask, hess * mask, mask], axis=-1)       # [C, 3]
+    clipped = jnp.minimum(comb.astype(jnp.int32), max_bin - 1)
+    flat = (row_leaf[:, None] * (nc * max_bin)
+            + jnp.arange(nc, dtype=jnp.int32)[None, :] * max_bin + clipped)
+    out = jnp.zeros((num_slots * nc * max_bin, 3), jnp.float32)
+    vals = jnp.broadcast_to(gh[:, None, :], (n, nc, 3)).reshape(n * nc, 3)
+    out = out.at[flat.reshape(-1)].add(vals)
+    return out.reshape(num_slots, nc, max_bin, 3)[:, :f]
+
+
+def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
+                        max_bin, block_rows, f):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, nc = comb.shape
+    B = max_bin
+    Bp = -(-B // 128) * 128
+    BR = block_rows
+    assert n % BR == 0 and BR % 128 == 0
+    nb = n // BR
+
+    gh = jnp.stack([grad * mask, hess * mask, mask], axis=0).astype(jnp.float32)
+    hi = gh.astype(jnp.bfloat16)
+    lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    gh6 = jnp.concatenate([hi, lo], axis=0)                       # [6, C] bf16
+
+    def kernel(bl_ref, bins_ref, gh_ref, out_ref):
+        i = pl.program_id(0)
+        # first block of a leaf slot initialises its accumulator (blocks of
+        # one slot are consecutive, so the [1, 6, f*Bp] out block stays in
+        # VMEM until the slot changes)
+        first = jnp.where(i == 0, True,
+                          bl_ref[i] != bl_ref[jnp.maximum(i - 1, 0)])
+
+        @pl.when(first)
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        b = bins_ref[:].astype(jnp.int32).T[:f]                   # [f, BR]
+        bin_id = jax.lax.broadcasted_iota(jnp.int32, (f, Bp, BR), 1)
+        onehot = (b[:, None, :] == bin_id).astype(jnp.bfloat16)
+        onehot = onehot.reshape(f * Bp, BR)
+        out_ref[0] += jax.lax.dot_general(
+            gh_ref[:], onehot,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                   # [6, f*Bp]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((BR, nc), lambda i, bl: (i, 0)),
+                  pl.BlockSpec((6, BR), lambda i, bl: (0, i))],
+        out_specs=pl.BlockSpec((1, 6, f * Bp), lambda i, bl: (bl[i], 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_slots, 6, f * Bp), jnp.float32),
+    )(block_leaf.astype(jnp.int32), comb, gh6)
+
+    out = out.reshape(num_slots, 2, 3, f, Bp)
+    hist = out[:, 0] + out[:, 1]                                  # hi + lo
+    return hist[:, :, :, :B].transpose(0, 2, 3, 1)                # [k, f, B, 3]
+
+
 def unrolled_rank(sorted_vals: jax.Array, targets: jax.Array,
                   strict: bool) -> jax.Array:
     """Per-target count of entries in ``sorted_vals`` that are ``< target``
