@@ -312,7 +312,15 @@ class Tree:
         else:
             lines.append("leaf_value=" + "{:.17g}".format(
                 self.leaf_value[0] if len(self.leaf_value) else 0.0))
-        if self.is_linear:
+        if not self.is_linear:
+            # ALWAYS write is_linear: the reference's text parser
+            # (tree.cpp:694) only assigns is_linear_ when the key is present
+            # and otherwise leaves the member uninitialized, so a file
+            # without it makes reference builds treat random trees as empty
+            # linear models (predicting 0); the reference's own writer emits
+            # it unconditionally (Tree::ToString, tree.cpp:375)
+            lines.append("is_linear=0")
+        else:
             # reference linear-tree grammar (Tree::ToString, tree.cpp:375-399)
             lines.append("is_linear=1")
             arr("leaf_const", self.leaf_const, "{:.17g}")

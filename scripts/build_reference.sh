@@ -1,0 +1,53 @@
+#!/bin/bash
+# Build the reference LightGBM CLI + lib out-of-tree for the interop tests
+# (tests/test_interop.py).  The reference mount is read-only and its
+# external_libs submodules are empty, so this stages a patched copy:
+#   - fmt: the spdlog-bundled copy shipped inside the tensorflow wheel
+#   - eigen: the Eigen headers shipped inside the tensorflow wheel
+#   - fast_double_parser: a strtod-backed stand-in (correctly rounded)
+#   - C++17 (the tensorflow Eigen needs >= C++14)
+# Produces /tmp/lgbm_src/lightgbm and /tmp/lgbm_src/lib_lightgbm.so
+# (~10 min).  Re-entrant: skips everything if the binary already runs.
+set -euo pipefail
+
+REF=${1:-/root/reference}
+SRC=/tmp/lgbm_src
+TF_INC=$(python - <<'EOF'
+import pathlib, tensorflow
+print(pathlib.Path(tensorflow.__file__).parent / "include")
+EOF
+)
+
+if [ -x "$SRC/lightgbm" ]; then
+    echo "reference binary already built: $SRC/lightgbm"
+    exit 0
+fi
+
+rm -rf "$SRC" /tmp/lgbm_build
+cp -r "$REF" "$SRC"
+rm -rf "$SRC/.git"
+
+mkdir -p "$SRC/external_libs/fmt/include/fmt"
+cp "$TF_INC"/external/spdlog/include/spdlog/fmt/bundled/*.h \
+   "$SRC/external_libs/fmt/include/fmt/"
+mkdir -p "$SRC/external_libs/eigen"
+cp -r "$TF_INC/Eigen" "$SRC/external_libs/eigen/Eigen"
+mkdir -p "$SRC/external_libs/fast_double_parser/include"
+cat > "$SRC/external_libs/fast_double_parser/include/fast_double_parser.h" <<'EOF'
+// Minimal stand-in for fast_double_parser used by the offline reference
+// build: parse via strtod (correctly rounded, just slower).
+#pragma once
+#include <cstdlib>
+namespace fast_double_parser {
+inline const char *parse_number(const char *p, double *outDouble) {
+  char *end = nullptr;
+  *outDouble = std::strtod(p, &end);
+  return end == p ? nullptr : end;
+}
+}  // namespace fast_double_parser
+EOF
+
+sed -i 's/-std=c++11 -pthread/-std=c++17 -pthread/' "$SRC/CMakeLists.txt"
+cmake -S "$SRC" -B /tmp/lgbm_build -DCMAKE_BUILD_TYPE=Release
+cmake --build /tmp/lgbm_build -j "$(nproc)"
+echo "built: $SRC/lightgbm"
