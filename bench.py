@@ -151,6 +151,14 @@ def main() -> None:
     auc_metric.init(md, n_rows)
     (_, auc, _), = auc_metric.eval(score)
 
+    # hard accuracy gate: the north star is throughput at IDENTICAL AUC, so
+    # a perf "win" that degrades accuracy must fail the bench, not post a
+    # green-looking number.  0.80 is ~0.03 under the synthetic generator's
+    # converged in-sample AUC at the bench config across shapes/backends.
+    auc_floor = float(os.environ.get("BENCH_AUC_FLOOR", 0.80))
+    # short smoke configs (< 10 trees) haven't converged — report, don't gate
+    auc_ok = auc >= auc_floor or (n_warmup + n_iters) < 10
+
     sec_per_tree = elapsed / n_iters
     row_iters_per_sec = n_rows * n_iters / elapsed
     print(json.dumps({
@@ -162,12 +170,15 @@ def main() -> None:
             "rows": n_rows, "iters_timed": n_iters,
             "num_leaves": num_leaves,
             "sec_per_tree": round(sec_per_tree, 4),
-            "auc": round(auc, 6),
+            "auc": round(auc, 6), "auc_floor": auc_floor,
             "backend": __import__("jax").default_backend(),
+            **({} if auc_ok else {"auc_below_floor": True}),
             **({"tpu_unreachable": True}
                if os.environ.get("_BENCH_REEXEC") else {}),
         },
     }))
+    if not auc_ok:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,131 @@
+"""Dual-kernel / dual-grower parity on the AMBIENT backend (the TPU).
+
+The hardware half of ``tests/test_dual.py``: the CPU CI backend cannot lower
+the Pallas kernels, so the r02-class failure (a lowering crash only a real
+TPU invocation surfaces) is caught here.  Wedge-safe: probes the backend in
+a subprocess before committing this process to it (see bench.probe_backend).
+
+Checks, in order (each emits one JSON line; first failure exits nonzero):
+  1. pallas row-major one-hot kernel vs XLA one-hot         (both layouts)
+  2. pallas feature-major blocked kernel vs XLA one-hot     (wide features)
+  3. pallas batched-leaf kernel vs scatter fallback         (frontier path)
+  4. frontier-vs-serial grower: identical trees on the TPU
+
+Run (the ONLY process touching the TPU):
+    python scripts/bench_dual.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def emit(**kv):
+    kv["ts"] = time.time()
+    print(json.dumps(kv), flush=True)
+
+
+def main() -> int:
+    import bench
+    if (not os.environ.get("BENCH_SKIP_PROBE")
+            and "axon" in os.environ.get("JAX_PLATFORMS", "axon")
+            and not bench.probe_backend(
+                float(os.environ.get("BENCH_PROBE_TIMEOUT", 300)))):
+        emit(stage="abort", reason="tpu_unreachable")
+        return 1
+    import jax
+    emit(stage="sanity", backend=jax.default_backend())
+    return run_checks(emit)
+
+
+def run_checks(emit) -> int:
+    """All dual checks, in-process (importable by tpu_perf_suite so only ONE
+    process ever touches the TPU).  Returns 0 when every check passes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lightgbm_tpu.ops.histogram import (_hist_onehot, _hist_pallas,
+                                            build_histogram_leaves,
+                                            _hist_leaves_pallas)
+    rng = np.random.default_rng(3)
+
+    def data(n, f, b):
+        bins = jnp.asarray(rng.integers(0, b, size=(n, f), dtype=np.uint8))
+        g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        h = jnp.asarray(rng.uniform(0.1, 1.0, size=n).astype(np.float32))
+        m = jnp.asarray((rng.uniform(size=n) < 0.8).astype(np.float32))
+        return bins, g, h, m
+
+    def relerr(a, b):
+        return float(jnp.max(jnp.abs(a - b) / (jnp.abs(b) + 1.0)))
+
+    rc = 0
+
+    # 1/2: one-hot kernel, row-major (f*Bp small) and feature-major (wide)
+    for name, (n, f, b) in (("rowmajor", (200_000, 28, 255)),
+                            ("featmajor", (100_000, 200, 255))):
+        bins, g, h, m = data(n, f, b)
+        try:
+            a = jax.jit(lambda *x: _hist_pallas(*x, b))(bins, g, h, m)
+            ref = jax.jit(lambda *x: _hist_onehot(*x, b, 65536))(bins, g, h, m)
+            err = relerr(a, ref)
+            ok = err < 1e-4
+            emit(stage=f"pallas_{name}", ok=ok, relerr=err)
+            rc |= 0 if ok else 1
+        except Exception as e:
+            emit(stage=f"pallas_{name}", ok=False, error=str(e)[:300])
+            rc |= 1
+
+    # 3: batched-leaf kernel (scalar-prefetched output block index)
+    BR, NB, NC, B, k = 512, 24, 32, 255, 6
+    C = BR * NB
+    comb = jnp.asarray(rng.integers(0, B, size=(C, NC), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=C).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=C).astype(np.float32))
+    m = jnp.asarray((rng.uniform(size=C) < 0.8).astype(np.float32))
+    bl = jnp.asarray(np.sort(rng.integers(0, k, size=NB)).astype(np.int32))
+    try:
+        got = jax.jit(lambda *x: _hist_leaves_pallas(*x, k, B, BR, 28))(
+            comb, g, h, m, bl)
+        ref = jax.jit(lambda *x: build_histogram_leaves(
+            *x, k, B, method="scatter", block_rows=BR, f_limit=28))(
+            comb, g, h, m, bl)
+        err = relerr(got, ref[:, :28])
+        ok = err < 1e-4
+        emit(stage="pallas_batched_leaves", ok=ok, relerr=err)
+        rc |= 0 if ok else 1
+    except Exception as e:
+        emit(stage="pallas_batched_leaves", ok=False, error=str(e)[:300])
+        rc |= 1
+
+    # 4: frontier-vs-serial grower on hardware — identical trees
+    try:
+        from sklearn.datasets import make_classification
+        import lightgbm_tpu as lgb
+        X, y = make_classification(n_samples=20000, n_features=12,
+                                   n_informative=7, random_state=7)
+        X = X.astype(np.float32)
+        out = {}
+        for grower in ("serial", "frontier"):
+            p = {"objective": "binary", "num_leaves": 63, "verbose": -1,
+                 "tree_grower": grower, "min_data_in_leaf": 20}
+            ds = lgb.Dataset(X, label=y, params=p)
+            out[grower] = lgb.train(p, ds, num_boost_round=3)
+        d = float(np.abs(out["serial"].predict(X)
+                         - out["frontier"].predict(X)).max())
+        ok = d < 1e-4
+        emit(stage="grower_dual", ok=ok, max_pred_diff=d)
+        rc |= 0 if ok else 1
+    except Exception as e:
+        emit(stage="grower_dual", ok=False, error=str(e)[:300])
+        rc |= 1
+
+    emit(stage="done", rc=rc)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,19 @@ def main():
     emit(stage="sanity", backend=jax.default_backend(),
          secs=round(time.perf_counter() - t0, 2))
 
+    # --- kernel parity FIRST (the r02 lowering crash was only visible on
+    # hardware): both one-hot layouts + the frontier batched-leaf kernel +
+    # grower dual.  A parity failure aborts before any perf number could be
+    # recorded off a wrong kernel.
+    if jax.default_backend() == "tpu":
+        import bench_dual
+
+        def emit_dual(**kv):
+            emit(stage="dual_" + kv.pop("stage", "?"), **kv)
+        if bench_dual.run_checks(emit_dual) != 0:
+            emit(stage="abort", reason="kernel_parity_failed")
+            return 1
+
     # --- histogram kernels at the bench shape ---------------------------
     from lightgbm_tpu.ops.histogram import _hist_onehot, _hist_pallas
     rng = np.random.default_rng(0)
@@ -93,21 +106,24 @@ def main():
                 nan_bins=jnp.full(F, -1, jnp.int32),
                 is_categorical=jnp.zeros(F, bool),
                 monotone=jnp.zeros(F, jnp.int32))
-    grow = jax.jit(lambda b_, g_, h_, rw, fm, k: grow_tree(
-        b_, g_, h_, rw, fm, **meta, key=k, cfg=cfg))
     rw = jnp.ones(N, jnp.float32)
     fm = jnp.ones(F, jnp.float32)
     key = jax.random.PRNGKey(0)
-    t = time.perf_counter()
-    tree, _ = grow(bins, g, h, rw, fm, key)
-    tree.leaf_value.block_until_ready()
-    emit(stage="grow_compile_plus_first", secs=round(time.perf_counter() - t, 1))
-    t = time.perf_counter()
-    for _ in range(3):
-        tree, _ = grow(bins, g + 1e-12, h, rw, fm, key)
-    tree.leaf_value.block_until_ready()
-    emit(stage="grow_steady", ms_per_tree=round(
-        (time.perf_counter() - t) / 3 * 1e3, 1))
+    for mode, iters in (("frontier", 5), ("serial", 2)):
+        cfg_m = cfg._replace(grower_mode=mode)
+        grow = jax.jit(lambda b_, g_, h_, rw_, fm_, k_, c=cfg_m: grow_tree(
+            b_, g_, h_, rw_, fm_, **meta, key=k_, cfg=c))
+        t = time.perf_counter()
+        tree, _ = grow(bins, g, h, rw, fm, key)
+        tree.leaf_value.block_until_ready()
+        emit(stage=f"grow_{mode}_compile_plus_first",
+             secs=round(time.perf_counter() - t, 1))
+        t = time.perf_counter()
+        for _ in range(iters):
+            tree, _ = grow(bins, g + 1e-12, h, rw, fm, key)
+        tree.leaf_value.block_until_ready()
+        emit(stage=f"grow_{mode}_steady", ms_per_tree=round(
+            (time.perf_counter() - t) / iters * 1e3, 1))
 
     # --- headline bench (in-process, same params as bench.py) ----------
     # one coherent shape for the whole story (a leftover BENCH_ROWS env
