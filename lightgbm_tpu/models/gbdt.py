@@ -469,13 +469,8 @@ class GBDT:
             return None, grad, hess
         if iteration % cfg.bagging_freq == 0:
             key = key_for_iteration(cfg.bagging_seed, iteration // cfg.bagging_freq)
-            u = jax.random.uniform(key, (n,))
-            if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
-                is_pos = self._label_dev > 0
-                frac = jnp.where(is_pos, cfg.pos_bagging_fraction, cfg.neg_bagging_fraction)
-            else:
-                frac = cfg.bagging_fraction
-            self._bag_mask = (u < frac).astype(jnp.float32)
+            self._bag_mask = bag_mask_from_uniform(
+                cfg, jax.random.uniform(key, (n,)), self._label_dev)
         mask = self._bag_mask
         return mask, grad * mask, hess * mask
 
@@ -1215,3 +1210,16 @@ class GBDT:
                     else:
                         imp[f] += tree.split_gain[j]
         return imp
+
+
+def bag_mask_from_uniform(cfg: Config, u, label):
+    """Bernoulli bagging mask from a per-row uniform draw (the shared math
+    of GBDT._bagging_weights and the distributed trainer — the two paths
+    must stay byte-identical for multi-process parity, so the formula
+    lives ONCE here; reference gbdt.cpp:182-262)."""
+    if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
+        frac = jnp.where(label > 0, cfg.pos_bagging_fraction,
+                         cfg.neg_bagging_fraction)
+    else:
+        frac = cfg.bagging_fraction
+    return (u < frac).astype(jnp.float32)

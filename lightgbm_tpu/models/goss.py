@@ -15,28 +15,38 @@ from ..utils.random_gen import key_for_iteration
 from .gbdt import GBDT
 
 
+def goss_mask_from_importance(cfg, imp, u, k_top: int):
+    """(mask, amplify) from per-row |g·h| importance and a per-row uniform
+    draw: EXACTLY ``k_top`` top rows plus an ``other_rate`` random sample of
+    the rest, sampled rows amplified by ``(1-top_rate)/other_rate``
+    (goss.hpp:103-152).  The shared math of GOSS._bagging_weights and the
+    distributed trainer — the two paths must stay byte-identical for
+    multi-process parity.  An ``imp >= threshold`` mask would inflate
+    unboundedly on ties (identical |g*h| is the norm in early iterations),
+    which both deviates from the reference's partial sort and defeats the
+    subset-capacity bound."""
+    n = imp.shape[0]
+    _, top_idx = jax.lax.top_k(imp, k_top)
+    is_top = jnp.zeros(n, bool).at[top_idx].set(True)
+    sampled = (u < cfg.other_rate) & ~is_top
+    mask = (is_top | sampled).astype(jnp.float32)
+    scale = (1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)
+    return mask, jnp.where(sampled, scale, 1.0)
+
+
 class GOSS(GBDT):
     def _bagging_weights(self, iteration, grad, hess):
         cfg = self.config
         n = self.train_data.num_data
-        top_rate, other_rate = cfg.top_rate, cfg.other_rate
-        if top_rate + other_rate >= 1.0:
+        if cfg.top_rate + cfg.other_rate >= 1.0:
             return None, grad, hess
         # importance = sum over classes of |g*h| (goss.hpp:115)
         imp = jnp.sum(jnp.abs(grad * hess), axis=0)
-        top_k = max(1, int(top_rate * n))
-        # EXACTLY top_k rows, like the reference's partial sort
-        # (``ArrayArgs::Partition`` + topN cut, goss.hpp:120-134); a
-        # ``imp >= threshold`` mask would inflate unboundedly on ties
-        # (identical |g*h| is the norm in early iterations), which both
-        # deviates from the reference and defeats the subset-capacity bound
-        _, top_idx = jax.lax.top_k(imp, top_k)
-        is_top = jnp.zeros(n, bool).at[top_idx].set(True)
         key = key_for_iteration(cfg.bagging_seed, iteration)
-        sampled = (jax.random.uniform(key, (n,)) < other_rate) & ~is_top
-        mask = (is_top | sampled).astype(jnp.float32)
-        scale = (1.0 - top_rate) / max(other_rate, 1e-12)
-        amplify = jnp.where(sampled, scale, 1.0)[None, :]
+        mask, amplify = goss_mask_from_importance(
+            cfg, imp, jax.random.uniform(key, (n,)),
+            max(1, int(cfg.top_rate * n)))
+        amplify = amplify[None, :]
         return mask, grad * amplify, hess * amplify
 
     # -- bagging-subset compaction (models/gbdt.py): GOSS keeps

@@ -42,11 +42,12 @@ from .mesh import DATA_AXIS
 
 def make_dp_train_step(grower_cfg: GrowerConfig,
                        feature_meta: dict,
-                       grad_fn: Callable,
+                       grad_fn: Optional[Callable],
                        learning_rate: float,
                        mesh: jax.sharding.Mesh,
                        axis_name: str = DATA_AXIS,
-                       num_class: int = 1):
+                       num_class: int = 1,
+                       external_grads: bool = False):
     """Build a jitted data-parallel one-iteration training step.
 
     Args:
@@ -84,12 +85,10 @@ def make_dp_train_step(grower_cfg: GrowerConfig,
         has_split = tree.num_leaves > 1
         return jnp.where(has_split, delta[node_assign], 0.0), tree
 
-    def step(bins, label, score, row_weight, weight, fmask, key):
+    def grow_all(grads, hesses, bins, score, row_weight, fmask, key):
         if K == 1:
-            grad, hess = grad_fn(score, label, weight)
-            d, tree = one_tree(grad, hess, bins, row_weight, fmask, key)
+            d, tree = one_tree(grads, hesses, bins, row_weight, fmask, key)
             return score + d, tree
-        grads, hesses = grad_fn(score, label, weight)        # [K, n] each
 
         def body(carry, xs):
             g, h, k = xs
@@ -102,6 +101,39 @@ def make_dp_train_step(grower_cfg: GrowerConfig,
         return score + deltas, trees
 
     score_spec = P(axis_name) if K == 1 else P(None, axis_name)
+    n_shards = mesh.shape[axis_name]
+
+    def check_rows(n):
+        if n % n_shards:
+            raise ValueError(
+                f"row count {n} is not divisible by the "
+                f"{n_shards}-way '{axis_name}' mesh axis; pad rows with "
+                f"pad_rows_to_multiple() and zero row_weight for pad rows")
+
+    if external_grads:
+        # gradients arrive precomputed (host-side rank objectives, GOSS /
+        # bagging amplification applied by the caller)
+        def step_ex(bins, grads, hesses, score, row_weight, fmask, key):
+            return grow_all(grads, hesses, bins, score, row_weight, fmask,
+                            key)
+
+        sharded = jax.shard_map(
+            step_ex, mesh=mesh,
+            in_specs=(P(axis_name), score_spec, score_spec, score_spec,
+                      P(axis_name), P(), P()),
+            out_specs=(score_spec, P()),
+            check_vma=False)
+        jitted = jax.jit(sharded)
+
+        def checked_ex(bins, grads, hesses, score, row_weight, fmask, key):
+            check_rows(bins.shape[0])
+            return jitted(bins, grads, hesses, score, row_weight, fmask, key)
+        return checked_ex
+
+    def step(bins, label, score, row_weight, weight, fmask, key):
+        grads, hesses = grad_fn(score, label, weight)
+        return grow_all(grads, hesses, bins, score, row_weight, fmask, key)
+
     sharded = jax.shard_map(
         step, mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), score_spec, P(axis_name),
@@ -109,14 +141,9 @@ def make_dp_train_step(grower_cfg: GrowerConfig,
         out_specs=(score_spec, P()),
         check_vma=False)  # tree outputs are replicated by construction (psum)
     jitted = jax.jit(sharded)
-    n_shards = mesh.shape[axis_name]
 
     def checked(bins, label, score, row_weight, fmask, key, weight=None):
-        if bins.shape[0] % n_shards:
-            raise ValueError(
-                f"row count {bins.shape[0]} is not divisible by the "
-                f"{n_shards}-way '{axis_name}' mesh axis; pad rows with "
-                f"pad_rows_to_multiple() and zero row_weight for pad rows")
+        check_rows(bins.shape[0])
         if weight is None:
             weight = jnp.ones_like(label)
         return jitted(bins, label, score, row_weight, weight, fmask, key)

@@ -10,6 +10,28 @@ mappers), the per-iteration step is ``make_dp_train_step``'s shard_map
 program whose psum/pmax collectives cross process boundaries over the
 global device mesh, and every process assembles the identical model from
 the replicated tree output.
+
+Feature coverage mirrors the reference's distributed training
+(``src/boosting/gbdt.cpp:228-262`` bagging on the shared row partition,
+``src/objective/rank_objective.hpp:25-67`` rank-local queries,
+``src/boosting/gbdt.cpp:517-575`` synced validation metrics):
+
+- **bagging** (incl. pos/neg fractions): the Bernoulli mask is drawn from
+  the seeded iteration key over the GLOBAL row order, so every rank agrees
+  and a multi-process run grows the same trees as a single process over
+  the concatenated rows;
+- **GOSS**: the top-rate cut is a global ``top_k`` over the sharded
+  |g·h| importance (XLA inserts the collectives), matching the
+  single-process exact-top-k semantics;
+- **feature_fraction**: the per-tree column mask derives from the seeded
+  numpy stream — identical on every rank by construction;
+- **lambdarank / rank_xendcg**: queries are rank-local (the reference's
+  distributed contract), gradients are computed per process on its local
+  rows and fed to the sharded grower as precomputed inputs;
+- **validation metrics**: additive metrics pool (sum, count); AUC pools
+  the raw (score, label) pairs exactly; NDCG@k / MAP@k pool per-query
+  means weighted by local query counts.  Early stopping follows the first
+  metric's higher/lower-better direction, rank-consistently.
 """
 from __future__ import annotations
 
@@ -26,22 +48,19 @@ from .mesh import DATA_AXIS
 
 
 def train_distributed(params, data, label, num_boost_round: Optional[int] = None,
-                      weight=None, valid_data=None,
+                      weight=None, group=None, valid_data=None,
+                      valid_group=None,
                       early_stopping_rounds: Optional[int] = None,
                       evals_result: Optional[dict] = None,
                       feature_name=None, categorical_feature=None):
     """Train over every ``jax.distributed`` process's local partition and
     return a ``Booster`` (identical on every process).
 
-    ``data``/``label``/``weight`` are THIS process's rows; ``valid_data``
-    an optional ``(X_local, y_local)`` validation shard.  Requires
+    ``data``/``label``/``weight``/``group`` are THIS process's rows (and
+    rank-local queries); ``valid_data`` an optional ``(X_local, y_local)``
+    validation shard with ``valid_group`` its local query sizes.  Requires
     ``parallel.mesh.init_distributed`` to have run.  Single-process calls
-    degrade to the ordinary engine.  Supports regression/binary/multiclass
-    objectives (globally pooled boost_from_average), sample weights, and
-    validation with GLOBALLY POOLED additive metrics (l2 / logloss /
-    multi_logloss — per-process sums allgathered, so every rank sees the
-    same curve and early stopping is rank-consistent); per-iteration
-    row/feature sampling is rejected explicitly.
+    degrade to the ordinary engine.
     """
     import jax
     import jax.numpy as jnp
@@ -55,6 +74,7 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
         cfg.enable_bundle = False
 
     ds = distributed_dataset(data, cfg, label=label, weight=weight,
+                             group=group,
                              categorical_feature=categorical_feature,
                              feature_names=feature_name)
     if jax.process_count() == 1:
@@ -64,7 +84,8 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
         valid_sets = None
         if valid_data is not None:
             vw = Dataset(valid_data[0], label=valid_data[1],
-                         reference=wrapper, params=dict(params or {}))
+                         group=valid_group, reference=wrapper,
+                         params=dict(params or {}))
             valid_sets = [vw]
         from ..engine import train as _train
         return _train(dict(params or {}), wrapper, num_boost_round=rounds,
@@ -81,24 +102,23 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
     check(objective is not None,
           "train_distributed requires a built-in objective")
     K = objective.num_model_per_iteration
-    # reject configs the fixed-ones row/feature masks would silently ignore
-    # (the per-iteration sampling machinery lives in the full GBDT loop)
-    check(cfg.bagging_freq == 0 or (cfg.bagging_fraction >= 1.0
-                                    and cfg.pos_bagging_fraction >= 1.0
-                                    and cfg.neg_bagging_fraction >= 1.0),
-          "train_distributed v1 does not support bagging")
-    check(cfg.feature_fraction >= 1.0 and cfg.feature_fraction_bynode >= 1.0,
-          "train_distributed v1 does not support feature_fraction")
-    check(cfg.boosting == "gbdt",
-          "train_distributed v1 supports boosting=gbdt only")
+    is_ranking = getattr(objective, "is_ranking", False)
+    check(cfg.boosting in ("gbdt", "goss"),
+          "train_distributed supports boosting=gbdt/goss")
+    check(cfg.feature_fraction_bynode >= 1.0,
+          "train_distributed does not support feature_fraction_bynode")
     check(not cfg.is_unbalance and cfg.scale_pos_weight == 1.0,
-          "train_distributed v1 does not support is_unbalance/"
+          "train_distributed does not support is_unbalance/"
           "scale_pos_weight (class stats would be per-shard, not global)")
+    if is_ranking:
+        check(group is not None,
+              "ranking objectives need rank-local `group` sizes")
 
     # --- equal per-process row blocks (pad rows ride weight 0) ----------
     n_local = ds.num_data
     d_local = jax.local_device_count()
-    per_proc = int(np.asarray(mhu.process_allgather(np.int64(n_local))).max())
+    n_locals = np.asarray(mhu.process_allgather(np.int64(n_local))).reshape(-1)
+    per_proc = int(n_locals.max())
     per_proc = -(-per_proc // d_local) * d_local
     pad = per_proc - n_local
     bins_l = np.pad(np.asarray(ds.bins), ((0, pad), (0, 0)))
@@ -109,18 +129,29 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
             if ds.metadata.weight is not None else np.ones(n_local, np.float32))
     w_l = np.pad(w_np, (0, pad))
     N = per_proc * jax.process_count()
+    n_global = int(n_locals.sum())
+    # TRUE global row index of every local (padded) position: bagging/GOSS
+    # draw per-row uniforms over the UNPADDED global order, so the masks
+    # match a single-process run over the concatenated rows even when
+    # shards are padded (pad rows point at 0 and ride weight 0)
+    my_off = int(n_locals[: jax.process_index()].sum())
+    gidx_l = np.pad(my_off + np.arange(n_local, dtype=np.int32), (0, pad))
 
     mesh = Mesh(np.array(jax.devices()), (DATA_AXIS,))
     sh = NamedSharding(mesh, P(DATA_AXIS))
     mk = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
         sh, a, (N,) + a.shape[1:])
     bins_g, label_g, rw_g, w_g = mk(bins_l), mk(label_l), mk(rw_l), mk(w_l)
+    gidx_g = mk(gidx_l)
+    ksh = NamedSharding(mesh, P(None, DATA_AXIS))
+    mk_k = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
+        ksh, a, (a.shape[0], N))
 
     # --- GLOBAL boost-from-average: only the weighted label sum/count
     # crosses processes (two scalars), then the objective's own formula
     # applies.  A per-process mean would give each rank a different init.
     inits = [0.0] * K
-    if cfg.boost_from_average:
+    if cfg.boost_from_average and not is_ranking:
         if cfg.objective == "regression":
             sums = np.asarray(mhu.process_allgather(np.asarray(
                 [float((w_np * label_np).sum()), float(w_np.sum())])))
@@ -161,37 +192,110 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
                 nan_bins=dd.nan_bins, is_categorical=dd.is_categorical,
                 monotone=dd.monotone)
 
-    if K == 1:
-        def grad_fn(score, lab, w):
-            return objective.get_gradients(score, lab, w)
-    else:
-        def grad_fn(score, lab, w):
-            return objective.get_gradients_multi(score, lab, w)
-
-    step = make_dp_train_step(gcfg, meta, grad_fn, cfg.learning_rate, mesh,
-                              num_class=K)
-    fmask = jnp.ones(ds.num_features, jnp.float32)
+    step = make_dp_train_step(gcfg, meta, None, cfg.learning_rate, mesh,
+                              num_class=K, external_grads=True)
     if K == 1:
         score_l = np.full((per_proc,), inits[0], np.float32)
         score = mk(score_l)
     else:
         score_l = np.tile(np.asarray(inits, np.float32)[:, None],
                           (1, per_proc))
-        score = jax.make_array_from_process_local_data(
-            NamedSharding(mesh, P(None, DATA_AXIS)), score_l, (K, N))
+        score = mk_k(score_l)
+
+    # --- per-iteration gradients (global sharded for elementwise
+    # objectives; host-local for rank objectives whose queries are
+    # rank-local by the reference's distributed contract) ----------------
+    if not is_ranking:
+        if K == 1:
+            grad_jit = jax.jit(
+                lambda sc, lab, w: objective.get_gradients(sc, lab, w))
+        else:
+            grad_jit = jax.jit(
+                lambda sc, lab, w: objective.get_gradients_multi(sc, lab, w))
+
+        def compute_grads(score, it):
+            g, h = grad_jit(score, label_g, w_g)
+            return g, h
+    else:
+        def _local_rows(arr):
+            shards = sorted(arr.addressable_shards,
+                            key=lambda s: s.index[-1].start or 0)
+            return np.concatenate([np.asarray(s.data, np.float32).reshape(-1)
+                                   for s in shards])
+
+        def compute_grads(score, it):
+            sc_local = _local_rows(score)[:n_local]
+            g, h = objective.get_gradients(jnp.asarray(sc_local),
+                                           jnp.asarray(label_np),
+                                           (jnp.asarray(w_np)
+                                            if ds.metadata.weight is not None
+                                            else None))
+            g = np.pad(np.asarray(g, np.float32), (0, pad))
+            h = np.pad(np.asarray(h, np.float32), (0, pad))
+            return mk(g), mk(h)
+
+    # --- row sampling: bagging (seeded global Bernoulli — every rank
+    # draws the identical mask) or GOSS (global top-k over |g*h|) --------
+    use_bagging = (cfg.boosting == "gbdt" and cfg.bagging_freq > 0
+                   and (cfg.bagging_fraction < 1.0
+                        or cfg.pos_bagging_fraction < 1.0
+                        or cfg.neg_bagging_fraction < 1.0))
+    use_goss = (cfg.boosting == "goss"
+                and cfg.top_rate + cfg.other_rate < 1.0)
+
+    if use_bagging:
+        from ..models.gbdt import bag_mask_from_uniform
+
+        @jax.jit
+        def bag_mask_fn(key, lab, gidx):
+            # draw over the UNPADDED global order, gather to padded layout
+            u = jnp.take(jax.random.uniform(key, (n_global,)), gidx)
+            return bag_mask_from_uniform(cfg, u, lab)
+        _bag_state = {}
+
+    if use_goss:
+        from ..models.goss import goss_mask_from_importance
+        k_top = max(1, int(cfg.top_rate * n_global))
+
+        @jax.jit
+        def goss_fn(g, h, base_rw, key, gidx):
+            imp = (jnp.abs(g * h) if K == 1
+                   else jnp.sum(jnp.abs(g * h), axis=0))
+            imp = imp * (base_rw > 0)
+            u = jnp.take(jax.random.uniform(key, (n_global,)), gidx)
+            mask, amplify = goss_mask_from_importance(cfg, imp, u, k_top)
+            return mask * base_rw, amplify
+
+    def sample(it, g, h):
+        """(row_weight, g, h) for this iteration after bagging/GOSS."""
+        if use_bagging:
+            if it % cfg.bagging_freq == 0:
+                key = key_for_iteration(cfg.bagging_seed,
+                                        it // cfg.bagging_freq)
+                _bag_state["mask"] = bag_mask_fn(key, label_g, gidx_g)
+            m = _bag_state["mask"]
+            rw = rw_g * m
+            mm = m if K == 1 else m[None, :]
+            return rw, g * mm, h * mm
+        if use_goss:
+            key = key_for_iteration(cfg.bagging_seed, it)
+            rw, amplify = goss_fn(g, h, rw_g, key, gidx_g)
+            am = amplify if K == 1 else amplify[None, :]
+            return rw, g * am, h * am
+        return rw_g, g, h
 
     # --- local validation shard, binned with the SHARED mappers ---------
     vbins = vlabel = None
     vscore = None
+    metrics = []
     check(valid_data is not None or not early_stopping_rounds,
           "early_stopping_rounds requires valid_data")
     if valid_data is not None:
-        check(cfg.objective in ("regression", "binary", "multiclass"),
-              "train_distributed pooled valid metrics support "
-              "regression/binary/multiclass (softmax) objectives")
         from ..io.dataset import Dataset as InnerDataset
         vds = InnerDataset.from_data(valid_data[0], cfg,
                                      label=valid_data[1], reference=ds)
+        if valid_group is not None:
+            vds.metadata.set_field("group", valid_group)
         vbins = jnp.asarray(vds.unbundled_bins())
         vlabel = np.asarray(vds.metadata.label, np.float64)
         vscore = np.tile(np.asarray(inits, np.float64)[:, None],
@@ -200,41 +304,20 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
 
         from ..ops.predict import predict_leaf_binned
         vpredict = jax.jit(lambda ta, b: predict_leaf_binned(ta, b, vnan))
-
-    def pooled_metric(sc):
-        """Globally pooled additive metric on the valid shard: every
-        process contributes (sum, count) — identical value on all ranks."""
-        if cfg.objective == "regression":
-            local = np.asarray([np.sum((sc[0] - vlabel) ** 2),
-                                len(vlabel)], np.float64)
-            name = "l2"
-        elif cfg.objective == "binary":
-            # the objective's OWN transform (sigmoid scaling included) —
-            # a hand-rolled formula here drifted from convert_output once
-            p1 = np.clip(np.asarray(objective.convert_output(sc[0]),
-                                    np.float64), 1e-15, 1 - 1e-15)
-            ll = -(vlabel * np.log(p1) + (1 - vlabel) * np.log(1 - p1))
-            local = np.asarray([ll.sum(), len(vlabel)], np.float64)
-            name = "binary_logloss"
-        else:                                   # multiclass softmax
-            prob = np.clip(np.asarray(objective.convert_output(sc),
-                                      np.float64), 1e-15, 1.0)
-            ll = -np.log(prob[vlabel.astype(np.int64),
-                              np.arange(len(vlabel))])
-            local = np.asarray([ll.sum(), len(vlabel)], np.float64)
-            name = "multi_logloss"
-        pooled = np.asarray(mhu.process_allgather(local)).reshape(-1, 2)
-        return name, float(pooled[:, 0].sum() / max(pooled[:, 1].sum(), 1.0))
+        metrics = _pooled_metrics(cfg, objective, vds, vlabel, mhu)
 
     trees = []
-    history: list = []
-    metric_name = None
+    history: dict = {}
     completed = rounds
-    best_metric, best_iter_num, since_best = np.inf, rounds, 0
+    first_hib = metrics[0]["higher_better"] if metrics else False
+    best_metric = -np.inf if first_hib else np.inf
+    best_iter_num, since_best = rounds, 0
     for it in range(rounds):
         key = key_for_iteration(cfg.seed, it, salt=1)
-        score, tree_arrays = step(bins_g, label_g, score, rw_g, fmask, key,
-                                  weight=w_g)
+        g, h = compute_grads(score, it)
+        rw_it, g, h = sample(it, g, h)
+        fmask = jnp.asarray(tmp._feature_mask(it))
+        score, tree_arrays = step(bins_g, g, h, score, rw_it, fmask, key)
         host = jax.device_get(tree_arrays)
         for k in range(K):
             hk = (host if K == 1
@@ -258,21 +341,28 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
                 leaf = np.asarray(vpredict(ta_local, vbins))
                 vscore[k] += vals_unbiased[leaf]
         if vbins is not None:
-            metric_name, mval = pooled_metric(vscore)
-            history.append(mval)
-            if mval < best_metric - 1e-12:
-                best_metric, best_iter_num, since_best = mval, it + 1, 0
-            else:
-                since_best += 1
+            first = True
+            for m in metrics:
+                for name, val in m["eval"](vscore):
+                    history.setdefault(name, []).append(val)
+                    if first:
+                        better = (val > best_metric + 1e-12 if first_hib
+                                  else val < best_metric - 1e-12)
+                        if better:
+                            best_metric, best_iter_num, since_best = \
+                                val, it + 1, 0
+                        else:
+                            since_best += 1
+                        first = False
             if (early_stopping_rounds
                     and since_best >= early_stopping_rounds):
                 Log.info("train_distributed: early stop at iter %d "
-                         "(best %s=%.6f @ %d)", it + 1, metric_name,
+                         "(best %.6f @ %d)", it + 1,
                          best_metric, best_iter_num)
                 completed = it + 1
                 break
     if evals_result is not None and history:
-        evals_result.setdefault("valid", {})[metric_name] = history
+        evals_result.setdefault("valid", {}).update(history)
 
     # --- identical Booster on every process -----------------------------
     gbdt = GBDT(cfg)
@@ -289,3 +379,113 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
     if history and early_stopping_rounds:
         bst.best_iteration = best_iter_num     # sklearn/num_iteration hooks
     return bst
+
+
+def _pooled_metrics(cfg, objective, vds, vlabel, mhu):
+    """Build the rank-consistent pooled validation metrics.
+
+    Each entry: ``{"name", "higher_better", "eval": vscore -> [(name,
+    value), ...]}`` where ``eval`` performs the cross-process pooling:
+
+    - additive metrics (l2/logloss/multi_logloss): (sum, count) pairs;
+    - auc: the raw (score, label, weight) triples allgather (valid shards
+      are small) and every rank runs the exact tie-corrected AUC;
+    - ndcg@k / map@k: queries are rank-local, so the local per-query mean
+      pools weighted by the local query count.
+    """
+    import numpy as np
+
+    names = list(cfg.metric) if cfg.metric else []
+    if not names:
+        names = [{"regression": "l2", "binary": "binary_logloss",
+                  "multiclass": "multi_logloss", "multiclassova":
+                  "multi_logloss", "lambdarank": "ndcg",
+                  "rank_xendcg": "ndcg"}.get(cfg.objective, "l2")]
+
+    def additive(fn, name):
+        def ev(vscore):
+            s, c = fn(vscore)
+            pooled = np.asarray(mhu.process_allgather(
+                np.asarray([s, c], np.float64))).reshape(-1, 2)
+            return [(name, float(pooled[:, 0].sum()
+                                 / max(pooled[:, 1].sum(), 1.0)))]
+        return ev
+
+    out = []
+    for name in names:
+        base = name.split("@")[0]
+        if base in ("l2", "mse", "regression"):
+            out.append({"name": "l2", "higher_better": False,
+                        "eval": additive(
+                            lambda sc: (float(np.sum((sc[0] - vlabel) ** 2)),
+                                        len(vlabel)), "l2")})
+        elif base in ("binary_logloss", "logloss"):
+            def bl(sc):
+                p1 = np.clip(np.asarray(objective.convert_output(sc[0]),
+                                        np.float64), 1e-15, 1 - 1e-15)
+                ll = -(vlabel * np.log(p1) + (1 - vlabel) * np.log(1 - p1))
+                return float(ll.sum()), len(vlabel)
+            out.append({"name": "binary_logloss", "higher_better": False,
+                        "eval": additive(bl, "binary_logloss")})
+        elif base in ("multi_logloss", "multiclass"):
+            def ml(sc):
+                prob = np.clip(np.asarray(objective.convert_output(sc),
+                                          np.float64), 1e-15, 1.0)
+                ll = -np.log(prob[vlabel.astype(np.int64),
+                                  np.arange(len(vlabel))])
+                return float(ll.sum()), len(vlabel)
+            out.append({"name": "multi_logloss", "higher_better": False,
+                        "eval": additive(ml, "multi_logloss")})
+        elif base == "auc":
+            # labels and shard sizes never change: pool them ONCE; each
+            # iteration only allgathers the scores
+            from ..metric.base import AUCMetric
+            from ..io.dataset import Metadata
+            n_here = len(vlabel)
+            n_max = int(np.asarray(mhu.process_allgather(
+                np.int64(n_here))).max())
+
+            def pads(a):
+                return np.pad(np.asarray(a, np.float64),
+                              (0, n_max - n_here))
+            lab_keep = np.asarray(mhu.process_allgather(np.stack(
+                [pads(vlabel), pads(np.ones(n_here))]))).reshape(-1, 2, n_max)
+            keep = lab_keep[:, 1].ravel() > 0
+            nkeep = int(keep.sum())
+            md = Metadata(nkeep)
+            md.set_field("label", lab_keep[:, 0].ravel()[keep])
+            auc_m = AUCMetric(cfg)
+            auc_m.init(md, nkeep)
+
+            def auc_ev(vscore, pads=pads, keep=keep, auc_m=auc_m):
+                pooled = np.asarray(mhu.process_allgather(
+                    pads(vscore[0]))).reshape(-1)[keep]
+                (_, val, _), = auc_m.eval(pooled)
+                return [("auc", float(val))]
+            out.append({"name": "auc", "higher_better": True,
+                        "eval": auc_ev})
+        elif base in ("ndcg", "map"):
+            from ..metric.rank import MapMetric, NDCGMetric
+            cls = NDCGMetric if base == "ndcg" else MapMetric
+            m = cls(cfg)
+            m.init(vds.metadata, vds.num_data)
+            qb = vds.metadata.query_boundaries
+            nq_local = len(qb) - 1 if qb is not None else 1
+
+            def rank_ev(vscore, m=m, nq_local=nq_local):
+                rows = m.eval(np.asarray(vscore[0], np.float64))
+                outv = []
+                for mname, val, _ in rows:
+                    pooled = np.asarray(mhu.process_allgather(np.asarray(
+                        [val * nq_local, nq_local], np.float64)))
+                    pooled = pooled.reshape(-1, 2)
+                    outv.append((mname, float(pooled[:, 0].sum()
+                                              / max(pooled[:, 1].sum(), 1))))
+                return outv
+            out.append({"name": base, "higher_better": True,
+                        "eval": rank_ev})
+        else:
+            Log.warning("train_distributed: metric '%s' is not pooled "
+                        "across processes; skipping", name)
+    check(bool(out), "no poolable validation metric")
+    return out

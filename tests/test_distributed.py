@@ -366,3 +366,258 @@ def test_set_network_brings_up_cluster(tmp_path):
     outs = _run_two_procs(tmp_path, _SETNET_WORKER, timeout=240)
     for pid, out in enumerate(outs):
         assert f"proc{pid} NETOK" in out, out
+
+
+_BAGGING_WORKER = r"""
+import sys
+import numpy as np
+
+proc_id = int(sys.argv[1]); coord = sys.argv[2]; outdir = sys.argv[3]
+sys.path.insert(0, "@REPO@")
+from lightgbm_tpu.parallel.mesh import init_distributed
+init_distributed(coordinator_address=coord, num_processes=2,
+                 process_id=proc_id)
+from lightgbm_tpu.parallel import train_distributed
+
+rng = np.random.default_rng(77)
+n, f = 3000, 8
+X = rng.normal(size=(n, f))
+y = (X[:, 0] + 0.5 * X[:, 1] + rng.logistic(size=n) * 0.3 > 0
+     ).astype(np.float32)
+lo, hi = (0, n // 2) if proc_id == 0 else (n // 2, n)   # equal: no padding
+
+params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+          "max_bin": 63, "verbose": -1, "seed": 5, "bagging_fraction": 0.6,
+          "bagging_freq": 1, "bagging_seed": 3, "feature_fraction": 0.75}
+bst = train_distributed(params, X[lo:hi], y[lo:hi], num_boost_round=6)
+if proc_id == 0:
+    bst.save_model(outdir + "/bagged.txt")
+print("proc{} BAGOK".format(proc_id))
+"""
+
+
+def test_two_process_bagging_matches_single(tmp_path):
+    """Per-rank Bernoulli bagging + feature_fraction with the agreed seed:
+    the 2-process model must equal the single-process model over the
+    concatenated rows (reference gbdt.cpp:228-262 — bagging happens on the
+    shared row partition)."""
+    import lightgbm_tpu as lgb
+    outs = _run_two_procs(tmp_path, _BAGGING_WORKER.replace(
+        "sys.argv[3]", f"'{tmp_path}'"), timeout=420)
+    for pid, out in enumerate(outs):
+        assert f"proc{pid} BAGOK" in out, out
+
+    rng = np.random.default_rng(77)
+    n, f = 3000, 8
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.logistic(size=n) * 0.3 > 0
+         ).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "max_bin": 63, "verbose": -1, "seed": 5,
+              "bagging_fraction": 0.6, "bagging_freq": 1, "bagging_seed": 3,
+              "feature_fraction": 0.75}
+    single = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                       num_boost_round=6)
+    dist = lgb.Booster(model_file=str(tmp_path / "bagged.txt"))
+    np.testing.assert_allclose(dist.predict(X), single.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+_GOSS_WORKER = r"""
+import sys
+import numpy as np
+
+proc_id = int(sys.argv[1]); coord = sys.argv[2]; outdir = sys.argv[3]
+sys.path.insert(0, "@REPO@")
+from lightgbm_tpu.parallel.mesh import init_distributed
+init_distributed(coordinator_address=coord, num_processes=2,
+                 process_id=proc_id)
+from lightgbm_tpu.parallel import train_distributed
+
+rng = np.random.default_rng(78)
+n, f = 3000, 8
+X = rng.normal(size=(n, f))
+y = (X[:, 0] - 0.7 * X[:, 2] + rng.logistic(size=n) * 0.3 > 0
+     ).astype(np.float32)
+lo, hi = (0, n // 2) if proc_id == 0 else (n // 2, n)
+
+params = {"objective": "binary", "boosting": "goss", "num_leaves": 15,
+          "min_data_in_leaf": 5, "max_bin": 63, "verbose": -1, "seed": 5,
+          "top_rate": 0.25, "other_rate": 0.15, "bagging_seed": 3}
+bst = train_distributed(params, X[lo:hi], y[lo:hi], num_boost_round=6)
+if proc_id == 0:
+    bst.save_model(outdir + "/goss.txt")
+print("proc{} GOSSOK".format(proc_id))
+"""
+
+
+def test_two_process_goss_matches_single(tmp_path):
+    """GOSS's top-rate cut as a global top_k over the sharded |g*h|: the
+    2-process model equals the single-process exact-top-k model."""
+    import lightgbm_tpu as lgb
+    outs = _run_two_procs(tmp_path, _GOSS_WORKER.replace(
+        "sys.argv[3]", f"'{tmp_path}'"), timeout=420)
+    for pid, out in enumerate(outs):
+        assert f"proc{pid} GOSSOK" in out, out
+
+    rng = np.random.default_rng(78)
+    n, f = 3000, 8
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] - 0.7 * X[:, 2] + rng.logistic(size=n) * 0.3 > 0
+         ).astype(np.float32)
+    params = {"objective": "binary", "boosting": "goss", "num_leaves": 15,
+              "min_data_in_leaf": 5, "max_bin": 63, "verbose": -1, "seed": 5,
+              "top_rate": 0.25, "other_rate": 0.15, "bagging_seed": 3}
+    single = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                       num_boost_round=6)
+    dist = lgb.Booster(model_file=str(tmp_path / "goss.txt"))
+    np.testing.assert_allclose(dist.predict(X), single.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+_RANK_WORKER = r"""
+import json, sys
+import numpy as np
+
+proc_id = int(sys.argv[1]); coord = sys.argv[2]; outdir = sys.argv[3]
+sys.path.insert(0, "@REPO@")
+from lightgbm_tpu.parallel.mesh import init_distributed
+init_distributed(coordinator_address=coord, num_processes=2,
+                 process_id=proc_id)
+from lightgbm_tpu.parallel import train_distributed
+
+rng = np.random.default_rng(79)
+nq, qsize = 60, 25                      # queries are rank-local
+n = nq * qsize
+X = rng.normal(size=(n, 6))
+rel = np.clip((X[:, 0] + 0.8 * X[:, 1]
+               + rng.normal(size=n) * 0.4) * 1.2 + 1.5, 0, 4)
+y = np.floor(rel).astype(np.float32)
+group = np.full(nq, qsize, np.int64)
+half_q = nq // 2
+lo, hi = (0, half_q * qsize) if proc_id == 0 else (half_q * qsize, n)
+g_local = group[:half_q] if proc_id == 0 else group[half_q:]
+# local validation shard: last 10 local queries
+vq = 10
+vlo = hi - vq * qsize
+ev = {}
+bst = train_distributed(
+    {"objective": "lambdarank", "num_leaves": 15, "min_data_in_leaf": 3,
+     "max_bin": 63, "verbose": -1, "seed": 5, "metric": ["ndcg"],
+     "eval_at": [5], "label_gain": list(np.power(2.0, np.arange(32)) - 1)},
+    X[lo:hi], y[lo:hi], group=g_local, num_boost_round=6,
+    valid_data=(X[vlo:hi], y[vlo:hi]),
+    valid_group=np.full(vq, qsize, np.int64), evals_result=ev)
+if proc_id == 0:
+    bst.save_model(outdir + "/rank.txt")
+    json.dump(ev, open(outdir + "/rank_ev.json", "w"))
+print("proc{} RANKOK".format(proc_id))
+"""
+
+
+def test_two_process_lambdarank_with_pooled_ndcg(tmp_path):
+    """lambdarank end-to-end across processes: rank-local queries, globally
+    identical trees, and the pooled NDCG@5 equals the single-process NDCG
+    over the union of the validation queries."""
+    import json
+    import lightgbm_tpu as lgb
+    outs = _run_two_procs(tmp_path, _RANK_WORKER.replace(
+        "sys.argv[3]", f"'{tmp_path}'"), timeout=420)
+    for pid, out in enumerate(outs):
+        assert f"proc{pid} RANKOK" in out, out
+
+    rng = np.random.default_rng(79)
+    nq, qsize = 60, 25
+    n = nq * qsize
+    X = rng.normal(size=(n, 6))
+    rel = np.clip((X[:, 0] + 0.8 * X[:, 1]
+                   + rng.normal(size=n) * 0.4) * 1.2 + 1.5, 0, 4)
+    y = np.floor(rel).astype(np.float32)
+    group = np.full(nq, qsize, np.int64)
+    params = {"objective": "lambdarank", "num_leaves": 15,
+              "min_data_in_leaf": 3, "max_bin": 63, "verbose": -1,
+              "seed": 5, "metric": ["ndcg"], "eval_at": [5],
+              "label_gain": list(np.power(2.0, np.arange(32)) - 1)}
+    single = lgb.train(params, lgb.Dataset(X, label=y, group=group,
+                                           params=params),
+                       num_boost_round=6)
+    dist = lgb.Booster(model_file=str(tmp_path / "rank.txt"))
+    np.testing.assert_allclose(dist.predict(X), single.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+    # pooled NDCG@5 equals the single-process metric over the SAME union
+    # of validation queries (the two ranks' last 10 local queries each)
+    ev = json.load(open(tmp_path / "rank_ev.json"))["valid"]
+    key = [k for k in ev if "ndcg" in k][0]
+    half_q = nq // 2
+    vq = 10
+    keep_q = list(range(half_q - vq, half_q)) + list(range(nq - vq, nq))
+    rows = np.concatenate([np.arange(q * qsize, (q + 1) * qsize)
+                           for q in keep_q])
+    from lightgbm_tpu.metric.rank import NDCGMetric
+    from lightgbm_tpu.io.dataset import Metadata
+    from lightgbm_tpu.config import Config
+    md = Metadata(len(rows))
+    md.set_field("label", y[rows])
+    md.set_field("group", np.full(2 * vq, qsize, np.int64))
+    m = NDCGMetric(Config.from_params({"eval_at": [5]}))
+    m.init(md, len(rows))
+    (_, expect, _), = m.eval(single.predict(X[rows], raw_score=True))
+    assert abs(ev[key][-1] - expect) < 5e-3, (ev[key][-1], expect)
+
+
+_AUC_WORKER = r"""
+import json, sys
+import numpy as np
+
+proc_id = int(sys.argv[1]); coord = sys.argv[2]; outdir = sys.argv[3]
+sys.path.insert(0, "@REPO@")
+from lightgbm_tpu.parallel.mesh import init_distributed
+init_distributed(coordinator_address=coord, num_processes=2,
+                 process_id=proc_id)
+from lightgbm_tpu.parallel import train_distributed
+
+rng = np.random.default_rng(80)
+n, f = 2400, 6
+X = rng.normal(size=(n, f))
+y = (X[:, 0] + 0.6 * X[:, 1] + rng.logistic(size=n) * 0.5 > 0
+     ).astype(np.float32)
+lo, hi = (0, n // 2) if proc_id == 0 else (n // 2, n)
+# UNEQUAL valid shards exercise the padded allgather
+vsz = 300 if proc_id == 0 else 200
+ev = {}
+bst = train_distributed(
+    {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+     "max_bin": 63, "verbose": -1, "seed": 5, "metric": ["auc"]},
+    X[lo:hi], y[lo:hi], num_boost_round=5,
+    valid_data=(X[hi - vsz:hi], y[hi - vsz:hi]), evals_result=ev)
+if proc_id == 0:
+    json.dump(ev, open(outdir + "/auc_ev.json", "w"))
+    bst.save_model(outdir + "/auc.txt")
+print("proc{} AUCPOOL {:.10f}".format(proc_id, ev["valid"]["auc"][-1]))
+"""
+
+
+def test_two_process_pooled_auc_exact(tmp_path):
+    """Distributed AUC pools the raw (score, label) pairs: both ranks see
+    the identical value, and it equals the exact single-machine AUC over
+    the union of the (unequal!) validation shards."""
+    import json
+    import lightgbm_tpu as lgb
+    outs = _run_two_procs(tmp_path, _AUC_WORKER.replace(
+        "sys.argv[3]", f"'{tmp_path}'"), timeout=420)
+    vals = [line.split()[-1] for out in outs
+            for line in out.splitlines() if "AUCPOOL" in line]
+    assert len(vals) == 2 and vals[0] == vals[1], outs
+
+    rng = np.random.default_rng(80)
+    n, f = 2400, 6
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.6 * X[:, 1] + rng.logistic(size=n) * 0.5 > 0
+         ).astype(np.float32)
+    dist = lgb.Booster(model_file=str(tmp_path / "auc.txt"))
+    rows = np.concatenate([np.arange(1200 - 300, 1200),
+                           np.arange(n - 200, n)])
+    from sklearn.metrics import roc_auc_score
+    expect = roc_auc_score(y[rows], dist.predict(X[rows]))
+    assert abs(float(vals[0]) - expect) < 1e-9, (vals[0], expect)
