@@ -66,10 +66,62 @@ def lambdarank(n_queries=200, seed=13, n_feat=16):
          os.path.join(d, "rank.test.query"))
 
 
+def multiclass(n_train=6000, n_test=500, n_feat=12, n_class=5, seed=17):
+    rng = np.random.default_rng(seed)
+    n = n_train + n_test
+    X = rng.normal(size=(n, n_feat))
+    centers = rng.normal(scale=2.0, size=(n_class, n_feat))
+    y = np.argmin(
+        ((X[:, None, :4] - centers[None, :, :4]) ** 2).sum(-1)
+        + rng.gumbel(scale=1.5, size=(n, n_class)), axis=1)
+    d = os.path.join(HERE, "multiclass_classification")
+    _write_tsv(os.path.join(d, "multiclass.train"), y[:n_train], X[:n_train])
+    _write_tsv(os.path.join(d, "multiclass.test"), y[n_train:], X[n_train:])
+
+
+def xendcg(n_queries=150, seed=19, n_feat=14):
+    # same ranking file format as lambdarank, different draw
+    rng = np.random.default_rng(seed)
+    d = os.path.join(HERE, "xendcg")
+
+    def make(nq, fname, qname):
+        rows, labels, qsizes = [], [], []
+        for _ in range(nq):
+            sz = int(rng.integers(6, 30))
+            qsizes.append(sz)
+            X = rng.normal(size=(sz, n_feat))
+            rel = 0.8 * X[:, 0] - 0.6 * X[:, 1] + rng.normal(scale=0.8, size=sz)
+            lab = np.clip(np.digitize(rel, [-0.6, 0.4, 1.4]), 0, 4)
+            rows.append(X)
+            labels.append(lab)
+        _write_tsv(fname, np.concatenate(labels), np.concatenate(rows))
+        np.savetxt(qname, np.asarray(qsizes, np.int64), fmt="%d")
+
+    make(n_queries, os.path.join(d, "rank.train"),
+         os.path.join(d, "rank.train.query"))
+    make(max(20, n_queries // 5), os.path.join(d, "rank.test"),
+         os.path.join(d, "rank.test.query"))
+
+
+def parallel_learning(n_train=4000, n_test=400, n_feat=10, seed=23):
+    rng = np.random.default_rng(seed)
+    n = n_train + n_test
+    X = rng.normal(size=(n, n_feat))
+    logit = 2.2 * X[:, 0] - 1.6 * X[:, 1] + 1.2 * X[:, 2] * X[:, 3]
+    y = (logit + rng.logistic(size=n) > 0).astype(np.int64)
+    d = os.path.join(HERE, "parallel_learning")
+    _write_tsv(os.path.join(d, "binary.train"), y[:n_train], X[:n_train])
+    _write_tsv(os.path.join(d, "binary.test"), y[n_train:], X[n_train:])
+
+
 if __name__ == "__main__":
-    for sub in ("binary_classification", "regression", "lambdarank"):
+    for sub in ("binary_classification", "regression", "lambdarank",
+                "multiclass_classification", "xendcg", "parallel_learning"):
         os.makedirs(os.path.join(HERE, sub), exist_ok=True)
     binary()
     regression()
     lambdarank()
+    multiclass()
+    xendcg()
+    parallel_learning()
     print("example datasets written under", HERE)

@@ -1,0 +1,81 @@
+"""Two-process data-parallel training over localhost — the analog of the
+reference's parallel_learning recipe (machine list + one lightgbm run per
+machine; its README.md).  The coordinator host and the machine count come
+from ``mlist.txt`` (the reference machine-list grammar); the port is
+re-picked free at launch so concurrent runs don't collide.  Each process
+holds HALF the training rows and ``train_distributed`` produces the
+identical Booster on both.
+
+On REAL multi-machine setups use ``parallel.set_network(machines)`` (one
+process per machine, rank resolved from the local address) or
+``parallel.mesh.init_distributed`` directly; on one host two ranks share
+every interface address, so the rank must be passed explicitly.
+
+Run:  python run_distributed.py
+"""
+import os
+import socket
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+_WORKER = r"""
+import os, sys
+import numpy as np
+
+proc_id = int(sys.argv[1])
+coord = sys.argv[2]
+num_machines = int(sys.argv[3])
+os.chdir(sys.argv[4])
+
+from lightgbm_tpu.parallel.mesh import init_distributed
+init_distributed(coordinator_address=coord, num_processes=num_machines,
+                 process_id=proc_id)
+from lightgbm_tpu.parallel import train_distributed
+from lightgbm_tpu.application import parse_config_file
+
+params = dict(parse_config_file("train.conf"))
+raw = np.loadtxt(params["data"], delimiter="\t")
+X, y = raw[:, 1:], raw[:, 0]
+half = len(y) // 2
+lo, hi = (0, half) if proc_id == 0 else (half, len(y))
+vraw = np.loadtxt(params["valid_data"], delimiter="\t")
+n_trees = int(params.pop("num_trees"))
+for k in ("task", "data", "valid_data", "output_model", "machine_list_file",
+          "is_training_metric", "metric_freq"):
+    params.pop(k, None)
+params["verbose"] = -1
+bst = train_distributed(params, X[lo:hi], y[lo:hi], num_boost_round=n_trees,
+                        valid_data=(vraw[:, 1:], vraw[:, 0]))
+if proc_id == 0:
+    bst.save_model("LightGBM_model.txt")
+print("proc%d trained %d trees" % (proc_id, bst.num_trees()))
+"""
+
+
+def main():
+    # machine list: first entry is the coordinator (reference rank-0 hub)
+    with open(os.path.join(HERE, "mlist.txt")) as f:
+        machines = [ln.split() for ln in f if ln.strip()]
+    coord_host = machines[0][0]
+    with socket.socket() as s:          # fresh port: no cross-run collision
+        s.bind((coord_host, 0))
+        coord = f"{coord_host}:{s.getsockname()[1]}"
+
+    procs = []
+    for pid in range(len(machines)):
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(pid), coord,
+             str(len(machines)), HERE], env=env))
+    rc = sum(p.wait() for p in procs)
+    if rc == 0:
+        print("distributed training complete -> LightGBM_model.txt")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

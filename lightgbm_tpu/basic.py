@@ -590,6 +590,7 @@ class Booster:
         if hasattr(data, "values"):
             data = data.values
         from .io.dataset import _is_sparse
+        in_fmt = getattr(data, "format", None) if _is_sparse(data) else None
         if _is_sparse(data):   # scipy.sparse: block-densified predict
             data = data.tocsr()
         else:
@@ -604,7 +605,11 @@ class Booster:
         if pred_leaf:
             return self._gbdt.predict_leaf_index(data, num_iteration)
         if pred_contrib:
-            return self._gbdt.predict_contrib(data, num_iteration, start_iteration)
+            # sparse-in -> sparse-out (input format preserved), like the
+            # reference python package's LGBM_BoosterPredictSparseOutput
+            return self._gbdt.predict_contrib(
+                data, num_iteration, start_iteration,
+                sparse=in_fmt is not None, sparse_format=in_fmt)
         return self._gbdt.predict(data, num_iteration, start_iteration, raw_score)
 
     # ------------------------------------------------------------------

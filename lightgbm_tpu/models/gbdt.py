@@ -1006,14 +1006,26 @@ class GBDT:
         return np.asarray(self.objective.convert_output(raw))
 
     def predict_contrib(self, X: np.ndarray, num_iteration: int = -1,
-                        start_iteration: int = 0) -> np.ndarray:
+                        start_iteration: int = 0, sparse: bool = False,
+                        sparse_format: "str | None" = None):
         """TreeSHAP feature contributions (reference ``GBDT::PredictContrib``
         via ``Tree::TreeSHAP``, ``tree.cpp:887``): per row, per class,
-        ``[num_features + 1]`` with the bias (expected value) last."""
+        ``[num_features + 1]`` with the bias (expected value) last.
+
+        ``sparse=True`` returns scipy CSR (one matrix, or a list of K for
+        multiclass) built block by block, so a wide-sparse input never
+        materializes the full dense contribution matrix — the analog of the
+        reference's ``LGBM_BoosterPredictSparseOutput``
+        (``src/c_api.cpp:1900``) and the python package's sparse-in →
+        sparse-out contract."""
         from ..ops.shap import tree_shap, expected_value
         if any(getattr(t, "is_linear", False) for t in self.models):
             raise LightGBMError(
                 "pred_contrib (TreeSHAP) is not supported for linear trees")
+        if sparse:
+            return self._predict_contrib_sparse(X, num_iteration,
+                                                start_iteration,
+                                                sparse_format)
         if _is_sparse_mat(X):
             return _blockwise_sparse(
                 X, lambda d: self.predict_contrib(d, num_iteration,
@@ -1035,6 +1047,44 @@ class GBDT:
                     out[:, k, :F] += tree_shap(t, X)
                     out[:, k, F] += expected_value(t)
         return out[:, 0, :] if K == 1 else out.reshape(n, K * (F + 1))
+
+    def _predict_contrib_sparse(self, X, num_iteration: int,
+                                start_iteration: int,
+                                sparse_format: "str | None" = None):
+        """Blockwise sparse TreeSHAP: CSR per block, stacked — peak memory
+        is one dense block, not the [n, F+1] matrix.  The block row count
+        is capped by total ELEMENTS, so a wide-sparse input (the case this
+        path exists for) still bounds the dense scratch."""
+        import scipy.sparse as sp
+        K = self.num_tree_per_iteration
+        Xc = X.tocsr() if _is_sparse_mat(X) else np.asarray(X, np.float64)
+        n, F = Xc.shape
+        block = max(1, min(_SPARSE_PREDICT_BLOCK,
+                           (64 << 20) // max(1, (F + 1) * K)))
+        blocks: List[list] = [[] for _ in range(K)]
+        for s in range(0, max(n, 1), block):
+            xb = Xc[s:s + block]
+            if _is_sparse_mat(xb):
+                xb = np.asarray(xb.toarray(), np.float64)
+            dense = self.predict_contrib(xb, num_iteration, start_iteration)
+            if K == 1:
+                blocks[0].append(sp.csr_matrix(dense))
+            else:
+                F1 = dense.shape[1] // K
+                for k in range(K):
+                    blocks[k].append(
+                        sp.csr_matrix(dense[:, k * F1:(k + 1) * F1]))
+        # format-preserving like the reference python package: CSC in ->
+        # CSC out (LGBM_BoosterPredictSparseOutput handles both layouts);
+        # the caller passes the ORIGINAL input format (Booster.predict
+        # normalizes the matrix to CSR before the blocks are cut)
+        fmt = sparse_format or (getattr(X, "format", "csr")
+                                if _is_sparse_mat(X) else "csr")
+        fmt = fmt if fmt in ("csr", "csc") else "csr"
+        mats = [sp.vstack(b, format=fmt) if len(b) > 1
+                else (b[0] if fmt == "csr" else b[0].tocsc())
+                for b in blocks]
+        return mats[0] if K == 1 else mats
 
     def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         if _is_sparse_mat(X):

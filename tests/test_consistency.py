@@ -23,7 +23,8 @@ EXAMPLES = os.path.join(REPO, "examples")
 def example_dirs(tmp_path_factory):
     """Generate the synthetic datasets into a throwaway copy of examples/."""
     dst = tmp_path_factory.mktemp("examples")
-    for sub in ("binary_classification", "regression", "lambdarank"):
+    for sub in ("binary_classification", "regression", "lambdarank",
+                "multiclass_classification", "xendcg", "parallel_learning"):
         shutil.copytree(os.path.join(EXAMPLES, sub), dst / sub)
     gen = dst / "generate_data.py"
     shutil.copy(os.path.join(EXAMPLES, "generate_data.py"), gen)
@@ -90,3 +91,35 @@ def test_regression_example(example_dirs):
 
 def test_lambdarank_example(example_dirs):
     _run_example(example_dirs, "lambdarank")
+
+
+def test_multiclass_example(example_dirs):
+    _run_example(example_dirs, "multiclass_classification")
+
+
+def test_xendcg_example(example_dirs):
+    _run_example(example_dirs, "xendcg")
+
+
+def test_parallel_learning_example(example_dirs):
+    """The parallel_learning recipe: the CLI accepts the reference grammar
+    (num_machines/machine_list_file warn + train single-process), and the
+    run_distributed.py driver trains the same config over two real
+    jax.distributed processes producing one model file."""
+    _run_example(example_dirs, "parallel_learning")
+    d = example_dirs / "parallel_learning"
+    shutil.copy(d / "LightGBM_model.txt", d / "LightGBM_model.txt.cli")
+    r = subprocess.run([sys.executable, str(d / "run_distributed.py")],
+                       capture_output=True, text=True, timeout=420,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu",
+                            "PYTHONPATH": REPO})
+    assert r.returncode == 0, r.stdout + r.stderr
+    dist = lgb.Booster(model_file=str(d / "LightGBM_model.txt"))
+    Xte, yte = _load_example(str(d), "binary.test")
+    p = dist.predict(Xte)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(yte, p) > 0.75
+    # no row/feature sampling in this config: the 2-process model must
+    # match the single-process CLI model over the same rows
+    cli = lgb.Booster(model_file=str(d / "LightGBM_model.txt.cli"))
+    np.testing.assert_allclose(p, cli.predict(Xte), rtol=1e-5, atol=1e-6)

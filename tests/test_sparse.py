@@ -101,11 +101,17 @@ def test_sparse_pred_leaf_and_contrib():
     leaf_s = b.predict(X, pred_leaf=True)
     leaf_d = b.predict(dense, pred_leaf=True)
     np.testing.assert_array_equal(leaf_s, leaf_d)
+    # sparse input -> sparse contribs (the reference python package's
+    # LGBM_BoosterPredictSparseOutput contract)
+    import scipy.sparse as sps
     c_s = b.predict(X, pred_contrib=True)
+    assert sps.issparse(c_s)
     c_d = b.predict(dense, pred_contrib=True)
-    np.testing.assert_allclose(c_s, c_d, rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(c_s.todense()), c_d,
+                               rtol=1e-6, atol=1e-8)
     # contributions + bias sum to the raw score
-    np.testing.assert_allclose(c_s.sum(axis=1), b.predict(X, raw_score=True),
+    np.testing.assert_allclose(np.asarray(c_s.sum(axis=1)).ravel(),
+                               b.predict(X, raw_score=True),
                                rtol=1e-5, atol=1e-6)
 
 
@@ -148,3 +154,61 @@ def test_wide_sparse_efb_width_collapse():
                   lgb.Dataset(X, label=y), num_boost_round=5)
     auc_in = float(np.mean((b.predict(X) > 0.5) == y))
     assert auc_in > 0.6
+
+
+def test_wide_sparse_contrib_memory_win():
+    """On a wide sparse matrix the CSR contribs must be far smaller than
+    the dense [n, F+1] matrix (the point of the reference's
+    LGBM_BoosterPredictSparseOutput, src/c_api.cpp:~1900)."""
+    import scipy.sparse as sps
+    rng = np.random.default_rng(9)
+    n, f = 2000, 600
+    X = sps.random(n, f, density=0.01, random_state=9, format="csr",
+                   dtype=np.float64)
+    y = (np.asarray(X[:, :20].sum(axis=1)).ravel() > 0.08).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "min_data_in_leaf": 5}
+    b = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=5)
+    c = b.predict(X, pred_contrib=True)
+    assert sps.issparse(c) and c.shape == (n, f + 1)
+    dense_bytes = n * (f + 1) * 8
+    sparse_bytes = c.data.nbytes + c.indices.nbytes + c.indptr.nbytes
+    assert sparse_bytes * 10 < dense_bytes, (sparse_bytes, dense_bytes)
+    # values agree with the dense path
+    cd = b._gbdt.predict_contrib(np.asarray(X.todense()))
+    np.testing.assert_allclose(np.asarray(c.todense()), cd,
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_multiclass_sparse_contrib_list():
+    import scipy.sparse as sps
+    rng = np.random.default_rng(10)
+    Xd = rng.normal(size=(900, 30)) * (rng.random((900, 30)) < 0.15)
+    y = ((Xd[:, 0] > 0.2).astype(int) + (Xd[:, 1] > 0.1)).astype(np.float64)
+    X = sps.csr_matrix(Xd)
+    p = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+         "verbose": -1, "min_data_in_leaf": 5}
+    b = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=3)
+    cs = b.predict(X, pred_contrib=True)
+    assert isinstance(cs, list) and len(cs) == 3
+    assert all(sps.issparse(m) and m.shape == (900, 31) for m in cs)
+    cd = b._gbdt.predict_contrib(Xd).reshape(900, 3, 31)
+    for k in range(3):
+        np.testing.assert_allclose(np.asarray(cs[k].todense()), cd[:, k],
+                                   rtol=1e-6, atol=1e-8)
+
+
+def test_csc_contrib_preserves_format():
+    import scipy.sparse as sps
+    rng = np.random.default_rng(11)
+    Xd = rng.normal(size=(300, 20)) * (rng.random((300, 20)) < 0.2)
+    y = (Xd[:, 0] > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+         "min_data_in_leaf": 5}
+    b = lgb.train(p, lgb.Dataset(sps.csr_matrix(Xd), label=y, params=p),
+                  num_boost_round=3)
+    c = b.predict(sps.csc_matrix(Xd), pred_contrib=True)
+    assert c.format == "csc"
+    np.testing.assert_allclose(np.asarray(c.todense()),
+                               b._gbdt.predict_contrib(Xd),
+                               rtol=1e-6, atol=1e-8)
