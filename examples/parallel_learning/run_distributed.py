@@ -24,6 +24,7 @@ _WORKER = r"""
 import os, sys
 import numpy as np
 
+sys.path.insert(0, sys.argv[5])        # repo root: works uninstalled
 proc_id = int(sys.argv[1])
 coord = sys.argv[2]
 num_machines = int(sys.argv[3])
@@ -70,7 +71,8 @@ def main():
         env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
         procs.append(subprocess.Popen(
             [sys.executable, "-c", _WORKER, str(pid), coord,
-             str(len(machines)), HERE], env=env))
+             str(len(machines)), HERE,
+             os.path.dirname(os.path.dirname(HERE))], env=env))
     rc = sum(p.wait() for p in procs)
     if rc == 0:
         print("distributed training complete -> LightGBM_model.txt")

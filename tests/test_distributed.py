@@ -143,7 +143,7 @@ print("proc{} BINOK".format(proc_id))
 """
 
 
-def _run_two_procs(tmp_path, src, timeout=240):
+def _run_n_procs(tmp_path, src, n_procs, timeout=420):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -151,7 +151,7 @@ def _run_two_procs(tmp_path, src, timeout=240):
     script = tmp_path / "worker.py"
     script.write_text(src.replace("@REPO@", REPO))
     procs = []
-    for pid in (0, 1):
+    for pid in range(n_procs):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = REPO
@@ -161,13 +161,14 @@ def _run_two_procs(tmp_path, src, timeout=240):
             [sys.executable, str(script), str(pid), coord],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True))
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=timeout)
-        outs.append(out)
+    outs = [p.communicate(timeout=timeout)[0] for p in procs]
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc{pid} failed:\n{out}"
     return outs
+
+
+def _run_two_procs(tmp_path, src, timeout=240):
+    return _run_n_procs(tmp_path, src, 2, timeout)
 
 
 def test_two_process_distributed_binning(tmp_path):
@@ -621,3 +622,47 @@ def test_two_process_pooled_auc_exact(tmp_path):
     from sklearn.metrics import roc_auc_score
     expect = roc_auc_score(y[rows], dist.predict(X[rows]))
     assert abs(float(vals[0]) - expect) < 1e-9, (vals[0], expect)
+
+
+_THREE_PROC_WORKER = r"""
+import hashlib, sys
+import numpy as np
+
+proc_id = int(sys.argv[1]); coord = sys.argv[2]
+sys.path.insert(0, "@REPO@")
+from lightgbm_tpu.parallel.mesh import init_distributed
+init_distributed(coordinator_address=coord, num_processes=3,
+                 process_id=proc_id)
+import jax
+assert jax.process_count() == 3
+from lightgbm_tpu.parallel import train_distributed
+
+rng = np.random.default_rng(91)
+n, f = 3000, 7                     # 7 features: non-divisible by 3 shards
+X = rng.normal(size=(n, f))
+y = (X[:, 0] - 0.8 * X[:, 1] + rng.logistic(size=n) * 0.4 > 0
+     ).astype(np.float32)
+# UNEQUAL thirds: padding + the global-order mask draws both exercised
+cuts = [0, 900, 2100, n]
+lo, hi = cuts[proc_id], cuts[proc_id + 1]
+bst = train_distributed(
+    {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+     "max_bin": 63, "verbose": -1, "seed": 5, "bagging_fraction": 0.7,
+     "bagging_freq": 1, "bagging_seed": 11},
+    X[lo:hi], y[lo:hi], num_boost_round=5)
+h = hashlib.sha256(bst.model_to_string().encode()).hexdigest()[:16]
+print("proc{} HASH3 {}".format(proc_id, h))
+print("proc{} THREEOK".format(proc_id))
+"""
+
+
+def test_three_process_unequal_shards_with_bagging(tmp_path):
+    """Rank-count edge cases beyond 2 processes: unequal thirds (padding),
+    a feature count not divisible by the shard count, and bagging's
+    global-order mask draws — identical model on all three ranks."""
+    outs = _run_n_procs(tmp_path, _THREE_PROC_WORKER, 3)
+    for pid, out in enumerate(outs):
+        assert f"proc{pid} THREEOK" in out, out
+    hashes = sorted(line.split()[-1] for out in outs
+                    for line in out.splitlines() if "HASH3" in line)
+    assert len(hashes) == 3 and len(set(hashes)) == 1, outs

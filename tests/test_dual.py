@@ -48,8 +48,16 @@ def test_hist_methods_train_same_model():
     preds = {}
     for method in ("scatter", "onehot"):
         train = lgb.Dataset(X, label=y)
+        # the serial grower isolates the method comparison: its scatter and
+        # onehot paths histogram identical row sets in identical order.
+        # (The frontier grower shares ONE batched kernel for both methods
+        # except the root pass, and make_classification's redundant columns
+        # produce exactly-tied gains whose resolution flips with summation
+        # order — kernel parity for it is covered by test_frontier and
+        # scripts/bench_dual.py.)
         bst = lgb.Booster(params={"objective": "binary", "num_leaves": 31,
-                                  "verbose": -1}, train_set=train)
+                                  "verbose": -1, "tree_grower": "serial"},
+                          train_set=train)
         gb = bst._gbdt
         gb._grower_cfg = gb._grower_cfg._replace(hist_method=method)
         gb.__dict__.pop("_grow_jit", None)
