@@ -121,6 +121,8 @@ def main() -> None:
         "min_data_in_leaf": 100,
         "min_sum_hessian_in_leaf": 100.0,
         "verbose": -1,
+        # tuned knobs from a prior tpu_perf_suite sweep, if any
+        **json.loads(os.environ.get("BENCH_PARAMS_EXTRA", "{}")),
     }
     train_set = lgb.Dataset(X, label=y, params=params)
     booster = lgb.Booster(params=params, train_set=train_set)

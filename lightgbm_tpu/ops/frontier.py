@@ -81,6 +81,7 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
     cw = cat_words(B)
     p = cfg.split
     axis = cfg.axis_name
+    mode = cfg.parallel_mode or ("data" if axis is not None else None)
     k = max(1, min(cfg.frontier_k, L - 1))
     BR = cfg.frontier_block_rows
     S = (L - 1) + 2 * k              # split-record capacity (overshoot slack)
@@ -137,20 +138,72 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         raw = combb[:, n_cols:].reshape(cap, 3, _gh_cols // 3)
         return jax.lax.bitcast_convert_type(raw, jnp.float32)
 
+    # --- shard-local feature metadata + mode-dispatched search ------------
+    # Mirrors the sequential grower's learner dispatch (grower.py find /
+    # _find_voting / _reduce_split_global = the reference's per-learner
+    # FindBestSplitsFromHistograms + SyncUpGlobalBestSplit).
+    if mode == "feature":
+        dev = jax.lax.axis_index(axis)
+        f_start = dev * f
+
+        def lslice(a):
+            return jax.lax.dynamic_slice_in_dim(a, f_start, f)
+        num_bins_l = lslice(num_bins)
+        default_bins_l = lslice(default_bins)
+        nan_bins_l = lslice(nan_bins)
+        is_cat_l = lslice(is_categorical)
+        mono_l = lslice(monotone)
+        fmask_l = lslice(feature_mask)
+        contri_l = (lslice(feature_contri) if feature_contri is not None
+                    else None)
+        f_full = feature_mask.shape[0]
+    else:
+        num_bins_l, default_bins_l, nan_bins_l = (num_bins, default_bins,
+                                                  nan_bins)
+        is_cat_l, mono_l = is_categorical, monotone
+        fmask_l, contri_l = feature_mask, feature_contri
+        f_full = f
+
     def reduce_hist(h):
-        return jax.lax.psum(h, axis) if axis is not None else h
+        # data: full-histogram allreduce; feature/voting keep shard-local
+        # stores (voting reduces only ELECTED slices inside the search)
+        return jax.lax.psum(h, axis) if mode == "data" else h
 
     def find(hist_fb, sum_g, sum_h, count):
+        if mode == "feature":
+            from .grower import _reduce_split_global
+            s = find_best_split(hist_fb, num_bins_l, default_bins_l,
+                                nan_bins_l, is_cat_l, mono_l, sum_g, sum_h,
+                                count, p, fmask_l,
+                                sorted_cat=cfg.sorted_cat, contri=contri_l)
+            s = s._replace(feature=s.feature + f_start)
+            return _reduce_split_global(s, axis)
+        if mode == "voting":
+            return _find_voting(hist_fb, sum_g, sum_h, count)
         return find_best_split(hist_fb, num_bins, default_bins, nan_bins,
                                is_categorical, monotone, sum_g, sum_h, count,
                                p, feature_mask, sorted_cat=cfg.sorted_cat,
+                               contri=feature_contri)
+
+    def _find_voting(hist, sum_g, sum_h, count):
+        """Local top-k proposal -> global vote -> reduce only elected
+        histograms (the election dataflow lives once in split.voting_elect,
+        shared with the sequential grower)."""
+        from .split import voting_elect
+        hist_e, emask = voting_elect(
+            hist, num_bins, nan_bins, is_categorical, monotone, sum_g,
+            sum_h, count, p, feature_mask, axis, cfg.top_k, cfg.num_shards,
+            sorted_cat=cfg.sorted_cat, contri=feature_contri)
+        return find_best_split(hist_e, num_bins, default_bins, nan_bins,
+                               is_categorical, monotone, sum_g, sum_h, count,
+                               p, emask, sorted_cat=cfg.sorted_cat,
                                contri=feature_contri)
 
     # ---- degenerate: no usable features -> single-leaf tree ---------------
     if f == 0:
         cnt = jnp.sum(row_weight)
         wgt = jnp.sum(hess * row_weight)
-        if axis is not None:
+        if mode in ("data", "voting"):
             cnt = jax.lax.psum(cnt, axis)
             wgt = jax.lax.psum(wgt, axis)
         empty = TreeArrays(
@@ -177,7 +230,8 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
                         chunk_rows=cfg.hist_chunk_rows))
     tot = jnp.stack([jnp.sum(grad * row_weight), jnp.sum(hess * row_weight),
                      jnp.sum(row_weight)])
-    if axis is not None:
+    if mode in ("data", "voting"):
+        # feature mode replicates rows, so local sums are already global
         tot = jax.lax.psum(tot, axis)
     root_split = find(expand_hist(root_hist), tot[0], tot[1], tot[2])
 
@@ -280,11 +334,23 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         act = si >= 0
         sic = jnp.maximum(si, 0)
         feat_p = sel_feat[sic]
-        col_id_p = col_of_feat[feat_p] if efb is not None else feat_p
         rowid = st["perm"]
-        colv = jnp.take(comb_flat,
-                        rowid * ncc + col_id_p).astype(jnp.int32)
-        colv = decode_col(colv, feat_p)
+        if mode == "feature":
+            # columns are sharded: the owner shard selects its local column
+            # and ONE [N] psum broadcasts it (rows are replicated, so every
+            # shard's perm/selection state is identical; grower.py
+            # partition_and_hist does the same per split — here it is once
+            # per ROUND)
+            local_ix = jnp.clip(feat_p - f_start, 0, f - 1)
+            owns = (feat_p >= f_start) & (feat_p < f_start + f)
+            colv_loc = jnp.take(comb_flat,
+                                rowid * ncc + local_ix).astype(jnp.int32)
+            colv = jax.lax.psum(jnp.where(owns & act, colv_loc, 0), axis)
+        else:
+            col_id_p = col_of_feat[feat_p] if efb is not None else feat_p
+            colv = jnp.take(comb_flat,
+                            rowid * ncc + col_id_p).astype(jnp.int32)
+            colv = decode_col(colv, feat_p)
         nb_p = nan_bins[feat_p]
         is_miss = (colv == nb_p) & (nb_p >= 0)
         wsel = jnp.take(sel_cbits.reshape(-1),

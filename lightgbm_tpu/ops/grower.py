@@ -222,7 +222,8 @@ class _BestSplits(NamedTuple):
 
 
 def _frontier_eligible(cfg: "GrowerConfig", n_cols: int, interaction_sets,
-                       cegb_coupled, cegb_lazy, forced) -> bool:
+                       cegb_coupled, cegb_lazy, forced,
+                       efb=None) -> bool:
     """True when the round-batched frontier grower (ops/frontier.py) can
     serve this call.  Cross-leaf-coupled features (monotone bounds, CEGB
     refunds, interaction branch masks, forced-split prefixes) and
@@ -238,7 +239,8 @@ def _frontier_eligible(cfg: "GrowerConfig", n_cols: int, interaction_sets,
           and not cfg.extra_trees
           and cfg.feature_fraction_bynode >= 1.0
           and cfg.cegb_split_penalty == 0.0
-          and mode in (None, "data"))
+          and mode in (None, "data", "feature", "voting")
+          and (efb is None or mode in (None, "data")))
     if ok and cfg.hist_method == "pallas":
         # the batched kernel only has the row-major layout; very wide
         # feature blocks exceed its lane budget
@@ -291,7 +293,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         ``col - off + 1`` mapping (identity for singleton bundles).
     """
     if _frontier_eligible(cfg, bins.shape[1], interaction_sets,
-                          cegb_coupled, cegb_lazy, forced):
+                          cegb_coupled, cegb_lazy, forced, efb):
         from .frontier import grow_tree_frontier
         return grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
                                   num_bins, default_bins, nan_bins,
@@ -647,31 +649,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     def _find_voting(hist, sum_g, sum_h, count, fmask, parent_output, lo, hi,
                      penalty=None, rand=None, mult=None):
         """Local top-k proposal → global vote → reduce only elected
-        histograms (voting_parallel_tree_learner.cpp:151-345)."""
-        # local gains with min-data/hessian gates scaled to the shard
-        # (reference scales by 1/num_machines, :61-63)
-        ns = max(1, cfg.num_shards)
-        p_loc = p._replace(
-            min_data_in_leaf=max(1, p.min_data_in_leaf // ns),
-            min_sum_hessian_in_leaf=p.min_sum_hessian_in_leaf / ns)
-        fg = per_feature_gains(hist, num_bins_l, nan_bins_l, is_cat_l, mono_l,
-                               sum_g / ns, sum_h / ns, count / ns, p_loc,
-                               fmask, parent_output, lo, hi,
-                               sorted_cat=cfg.sorted_cat, gain_mult=mult,
-                               contri=feature_contri)
-        k = min(cfg.top_k, f_full)
-        topv, topi = jax.lax.top_k(fg, k)
-        votes = jnp.zeros(f_full, jnp.float32).at[topi].add(
-            jnp.where(topv > NEG_INF / 2, 1.0, 0.0))
-        votes = jax.lax.psum(votes, axis)
-        # elect 2k features (GlobalVoting); deterministic tie-break by index
-        score = votes * (f_full + 1.0) - jnp.arange(f_full, dtype=jnp.float32)
-        k2 = min(2 * k, f_full)
-        _, elected = jax.lax.top_k(score, k2)                # [2k], replicated
-        h_glob = jax.lax.psum(hist[elected], axis)           # [2k, B, 3]
-        hist_e = jnp.zeros_like(hist).at[elected].set(h_glob)
-        emask = jnp.zeros(f_full, jnp.float32).at[elected].set(1.0)
-        emask = jnp.where(fmask > 0, emask, 0.0)
+        histograms (voting_parallel_tree_learner.cpp:151-345; the election
+        dataflow lives once in split.voting_elect, shared with the frontier
+        grower)."""
+        from .split import voting_elect
+        hist_e, emask = voting_elect(
+            hist, num_bins_l, nan_bins_l, is_cat_l, mono_l, sum_g, sum_h,
+            count, p, fmask, axis, cfg.top_k, cfg.num_shards, parent_output,
+            lo, hi, sorted_cat=cfg.sorted_cat, gain_mult=mult,
+            contri=feature_contri)
         return find_best_split(hist_e, num_bins_l, default_bins_l, nan_bins_l,
                                is_cat_l, mono_l, sum_g, sum_h, count, p,
                                emask, parent_output, lo, hi, penalty, rand,

@@ -468,3 +468,47 @@ def _gain_at(left, right, total, monotone, p: SplitParams,
         bad = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
         ok = ok & ~bad
     return jnp.where(ok, gain, NEG_INF), None
+
+
+def voting_elect(hist, num_bins, nan_bins, is_categorical, monotone,
+                 sum_g, sum_h, count, p: SplitParams, feature_mask,
+                 axis_name: str, top_k: int, num_shards: int,
+                 parent_output=0.0, output_lo=NEG_INF, output_hi=-NEG_INF,
+                 sorted_cat: bool = True, gain_mult=None, contri=None):
+    """Voting-parallel election: local top-k proposal -> global vote ->
+    psum only the ELECTED feature histograms
+    (``voting_parallel_tree_learner.cpp:151-345``).  Returns
+    ``(hist_elected, elected_mask)`` for the caller's final
+    ``find_best_split`` — shared by the sequential grower and the frontier
+    grower so the election dataflow lives exactly once.
+
+    Local gains run with min-data/hessian gates scaled to the shard
+    (reference scales by 1/num_machines, ``:61-63``); the election ranks
+    PENALIZED gains (gain_mult/contri) like the reference's SplitInfo vote.
+    """
+    import jax
+
+    ns = max(1, num_shards)
+    p_loc = p._replace(
+        min_data_in_leaf=max(1, p.min_data_in_leaf // ns),
+        min_sum_hessian_in_leaf=p.min_sum_hessian_in_leaf / ns)
+    fg = per_feature_gains(hist, num_bins, nan_bins, is_categorical,
+                           monotone, sum_g / ns, sum_h / ns, count / ns,
+                           p_loc, feature_mask, parent_output, output_lo,
+                           output_hi, sorted_cat=sorted_cat,
+                           gain_mult=gain_mult, contri=contri)
+    f_full = feature_mask.shape[0]
+    kv = min(top_k, f_full)
+    topv, topi = jax.lax.top_k(fg, kv)
+    votes = jnp.zeros(f_full, jnp.float32).at[topi].add(
+        jnp.where(topv > NEG_INF / 2, 1.0, 0.0))
+    votes = jax.lax.psum(votes, axis_name)
+    # elect 2k features (GlobalVoting); deterministic tie-break by index
+    score = votes * (f_full + 1.0) - jnp.arange(f_full, dtype=jnp.float32)
+    k2 = min(2 * kv, f_full)
+    _, elected = jax.lax.top_k(score, k2)
+    h_glob = jax.lax.psum(hist[elected], axis_name)
+    hist_e = jnp.zeros_like(hist).at[elected].set(h_glob)
+    emask = jnp.zeros(f_full, jnp.float32).at[elected].set(1.0)
+    emask = jnp.where(feature_mask > 0, emask, 0.0)
+    return hist_e, emask

@@ -109,21 +109,39 @@ def main():
     rw = jnp.ones(N, jnp.float32)
     fm = jnp.ones(F, jnp.float32)
     key = jax.random.PRNGKey(0)
-    for mode, iters in (("frontier", 5), ("serial", 2)):
-        cfg_m = cfg._replace(grower_mode=mode)
+
+    def time_grow(cfg_m, tag, iters):
         grow = jax.jit(lambda b_, g_, h_, rw_, fm_, k_, c=cfg_m: grow_tree(
             b_, g_, h_, rw_, fm_, **meta, key=k_, cfg=c))
         t = time.perf_counter()
         tree, _ = grow(bins, g, h, rw, fm, key)
         tree.leaf_value.block_until_ready()
-        emit(stage=f"grow_{mode}_compile_plus_first",
+        emit(stage=f"grow_{tag}_compile_plus_first",
              secs=round(time.perf_counter() - t, 1))
         t = time.perf_counter()
         for _ in range(iters):
             tree, _ = grow(bins, g + 1e-12, h, rw, fm, key)
         tree.leaf_value.block_until_ready()
-        emit(stage=f"grow_{mode}_steady", ms_per_tree=round(
-            (time.perf_counter() - t) / iters * 1e3, 1))
+        ms = (time.perf_counter() - t) / iters * 1e3
+        emit(stage=f"grow_{tag}_steady", ms_per_tree=round(ms, 1))
+        return ms
+
+    best = (None, float("inf"))
+    # frontier_k sweep: the batch width trades per-round fixed cost against
+    # block-padding waste — pick the winner for the headline bench
+    for fk, br in ((16, 512), (32, 512), (8, 512), (16, 1024)):
+        cfg_m = cfg._replace(grower_mode="frontier", frontier_k=fk,
+                             frontier_block_rows=br)
+        ms = time_grow(cfg_m, f"frontier_k{fk}_br{br}", iters=4)
+        if ms < best[1]:
+            best = ((fk, br), ms)
+    emit(stage="frontier_best", k=best[0][0], block_rows=best[0][1],
+         ms_per_tree=round(best[1], 1))
+    time_grow(cfg._replace(grower_mode="serial"), "serial", iters=2)
+    # merge the sweep winner UNDER any user-provided knobs (theirs win)
+    os.environ["BENCH_PARAMS_EXTRA"] = json.dumps(
+        {"frontier_k": best[0][0], "frontier_block_rows": best[0][1],
+         **json.loads(os.environ.get("BENCH_PARAMS_EXTRA", "{}"))})
 
     # --- headline bench (in-process, same params as bench.py) ----------
     # one coherent shape for the whole story (a leftover BENCH_ROWS env

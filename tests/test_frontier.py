@@ -184,3 +184,35 @@ def test_data_parallel_frontier_parity(clf_data):
     bs = lgb.train(p2, lgb.Dataset(X, label=y, params=p2), num_boost_round=3)
     np.testing.assert_allclose(bs.predict(X), bd.predict(X), rtol=1e-4,
                                atol=1e-6)
+
+
+@pytest.mark.parametrize("learner", ["feature", "voting"])
+def test_parallel_mode_frontier_parity(clf_data, learner):
+    # feature- and voting-parallel over the 8-device mesh must engage the
+    # frontier grower and reproduce the serial model
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    import lightgbm_tpu.ops.frontier as F
+    X, y = clf_data
+    calls = {"n": 0}
+    orig = F.grow_tree_frontier
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    F.grow_tree_frontier = spy
+    try:
+        p = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+             "min_data_in_leaf": 5, "tree_learner": learner}
+        bp = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                       num_boost_round=3)
+    finally:
+        F.grow_tree_frontier = orig
+    assert calls["n"] > 0
+    ps = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+          "min_data_in_leaf": 5}
+    bs = lgb.train(ps, lgb.Dataset(X, label=y, params=ps), num_boost_round=3)
+    np.testing.assert_allclose(bp.predict(X), bs.predict(X), rtol=1e-5,
+                               atol=1e-6)
