@@ -21,6 +21,11 @@ import numpy as np
 # reference throughput: 10.5M rows * 500 iters / 130.094 s  (Experiments.rst:113)
 _REF_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 130.094
 
+# published peak bf16 matmul rate per chip kind (for the MFU detail figure)
+_PEAK_BF16_FLOPS = {"tpu v4": 275e12, "tpu v5e": 197e12,
+                    "tpu v5 lite": 197e12, "tpu v5p": 459e12,
+                    "tpu v6e": 918e12, "tpu v6 lite": 918e12}
+
 
 def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 42):
     """Synthetic stand-in with Higgs geometry (dense floats, ~even classes)."""
@@ -163,6 +168,36 @@ def main() -> None:
 
     sec_per_tree = elapsed / n_iters
     row_iters_per_sec = n_rows * n_iters / elapsed
+
+    # measured MFU of the histogram kernel at the bench shape: the one-hot
+    # matmul moves 2 * 6ch * N * F * Bp flops per full pass; peak is the
+    # chip's bf16 rate.  ~1s extra; TPU-only.
+    mfu_detail = {}
+    import jax as _jax
+    if _jax.default_backend() == "tpu":
+        _kind = _jax.devices()[0].device_kind
+        _peak = _PEAK_BF16_FLOPS.get(_kind.lower(), 197e12)
+        try:
+            import jax.numpy as _jnp
+            from lightgbm_tpu.ops.histogram import _hist_pallas
+            _bins = _jnp.asarray(train_set.construct()._inner.bins)
+            _F, _B = _bins.shape[1], int(params["max_bin"])
+            _Bp = -(-_B // 128) * 128
+            _g = booster._gbdt._train_score[0].astype(_jnp.float32)
+            _ones = _jnp.ones(n_rows, _jnp.float32)
+            _hfn = _jax.jit(lambda b, g: _hist_pallas(b, g, g, _ones, _B))
+            _hfn(_bins, _g).block_until_ready()
+            _t0 = time.perf_counter()
+            for _ in range(5):
+                _r = _hfn(_bins, _g + 1e-12)
+            _r.block_until_ready()
+            _dt = (time.perf_counter() - _t0) / 5
+            _flops = 2.0 * 6 * n_rows * _F * _Bp
+            mfu_detail = {"hist_kernel_ms": round(_dt * 1e3, 3),
+                          "hist_mfu": round(_flops / _dt / _peak, 4),
+                          "chip": _kind}
+        except Exception as e:                       # never fail the bench
+            mfu_detail = {"hist_mfu_error": str(e)[:120]}
     print(json.dumps({
         "metric": "higgs_1m_train_throughput",
         "value": round(row_iters_per_sec / 1e6, 4),
@@ -174,6 +209,7 @@ def main() -> None:
             "sec_per_tree": round(sec_per_tree, 4),
             "auc": round(auc, 6), "auc_floor": auc_floor,
             "backend": __import__("jax").default_backend(),
+            **mfu_detail,
             **({} if auc_ok else {"auc_below_floor": True}),
             **({"tpu_unreachable": True}
                if os.environ.get("_BENCH_REEXEC") else {}),

@@ -156,13 +156,6 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         fmask_l = lslice(feature_mask)
         contri_l = (lslice(feature_contri) if feature_contri is not None
                     else None)
-        f_full = feature_mask.shape[0]
-    else:
-        num_bins_l, default_bins_l, nan_bins_l = (num_bins, default_bins,
-                                                  nan_bins)
-        is_cat_l, mono_l = is_categorical, monotone
-        fmask_l, contri_l = feature_mask, feature_contri
-        f_full = f
 
     def reduce_hist(h):
         # data: full-histogram allreduce; feature/voting keep shard-local

@@ -124,17 +124,19 @@ def build_histogram_leaves(comb: jax.Array, grad: jax.Array, hess: jax.Array,
     ``F = f_limit or NC`` (the XLA fallback returns all NC columns, trailing
     packed-gradient columns as garbage for the caller to slice).
 
-    The Pallas path re-uses the row-major one-hot MXU kernel with the output
-    block index scalar-prefetched from ``block_leaf`` — same-leaf blocks are
-    consecutive, so each ``[6, F*Bp]`` leaf histogram stays VMEM-resident
-    across its row blocks and flushes once (the reference GPU kernels'
-    per-workgroup shared-memory accumulation, ``histogram256.cl:100``,
-    with the workgroup->leaf map replacing the workgroup->feature-group map).
+    The Pallas path re-uses the row-major one-hot MXU kernel with the whole
+    ``[num_slots, 6, F*Bp]`` accumulator VMEM-resident for the full grid;
+    each row block accumulates into its ``block_leaf``-indexed slot row and
+    the buffer flushes to HBM once (the reference GPU kernels' per-workgroup
+    shared-memory accumulation, ``histogram256.cl:100``, with the slot index
+    replacing the workgroup->feature-group map).  ``block_leaf`` need not be
+    sorted and slots may be empty (they come back zero).
     """
     n, nc = comb.shape
     f = min(f_limit, nc) if f_limit is not None else nc
-    if method == "pallas" and f * (-(-max_bin // 128) * 128) <= \
-            _PALLAS_ROWMAJOR_MAX_LANES:
+    _lanes = f * (-(-max_bin // 128) * 128)
+    if method == "pallas" and _lanes <= _PALLAS_ROWMAJOR_MAX_LANES \
+            and num_slots * 6 * _lanes * 4 <= _PALLAS_LEAFACC_BYTES:
         return _hist_leaves_pallas(comb, grad, hess, mask, block_leaf,
                                    num_slots, max_bin, block_rows, f)
     # XLA fallback: one scatter-add with the leaf slot folded into the flat
@@ -167,15 +169,19 @@ def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
     lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
     gh6 = jnp.concatenate([hi, lo], axis=0)                       # [6, C] bf16
 
+    # The WHOLE [num_slots, 6, f*Bp] accumulator rides one constant-index
+    # output block: it stays VMEM-resident across the entire grid (k=16
+    # slots x 28 feats x 256 bins f32 = 2.8MB) and flushes to HBM once.
+    # This zeroes every slot up front — a slot with no row blocks is
+    # well-defined zeros, not stale HBM — and avoids the dynamic output
+    # block index entirely (a [1,6,f*Bp] block keyed on bl[i] silently
+    # dropped the lo-half contributions on real v5e hardware: relerr ~1e-2
+    # vs the ~1e-6 this split-precision design gives; caught by
+    # scripts/bench_dual.py's hardware parity gate, round 4).
     def kernel(bl_ref, bins_ref, gh_ref, out_ref):
         i = pl.program_id(0)
-        # first block of a leaf slot initialises its accumulator (blocks of
-        # one slot are consecutive, so the [1, 6, f*Bp] out block stays in
-        # VMEM until the slot changes)
-        first = jnp.where(i == 0, True,
-                          bl_ref[i] != bl_ref[jnp.maximum(i - 1, 0)])
 
-        @pl.when(first)
+        @pl.when(i == 0)
         def _init():
             out_ref[:] = jnp.zeros_like(out_ref)
 
@@ -183,17 +189,20 @@ def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
         bin_id = jax.lax.broadcasted_iota(jnp.int32, (f, Bp, BR), 1)
         onehot = (b[:, None, :] == bin_id).astype(jnp.bfloat16)
         onehot = onehot.reshape(f * Bp, BR)
-        out_ref[0] += jax.lax.dot_general(
+        acc = jax.lax.dot_general(
             gh_ref[:], onehot,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)                   # [6, f*Bp]
+        sl = bl_ref[i]
+        out_ref[pl.ds(sl, 1)] += acc[None]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nb,),
         in_specs=[pl.BlockSpec((BR, nc), lambda i, bl: (i, 0)),
                   pl.BlockSpec((6, BR), lambda i, bl: (0, i))],
-        out_specs=pl.BlockSpec((1, 6, f * Bp), lambda i, bl: (bl[i], 0, 0)),
+        out_specs=pl.BlockSpec((num_slots, 6, f * Bp),
+                               lambda i, bl: (0, 0, 0)),
     )
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
@@ -239,6 +248,11 @@ _PALLAS_ONEHOT_BYTES = 8 * 1024 * 1024
 # cap so that the 128-row BR floor never busts _PALLAS_ONEHOT_BYTES:
 # f*Bp*128 bf16 <= 8MiB  =>  f*Bp <= 32768
 _PALLAS_ROWMAJOR_MAX_LANES = 32768
+
+# the batched-leaf kernel keeps its whole [num_slots, 6, f*Bp] f32
+# accumulator VMEM-resident for the full grid; cap it so accumulator +
+# one-hot tile + I/O blocks stay well inside v5e's ~128MB VMEM
+_PALLAS_LEAFACC_BYTES = 48 * 1024 * 1024
 
 
 def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,

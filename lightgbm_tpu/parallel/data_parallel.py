@@ -47,7 +47,8 @@ def make_dp_train_step(grower_cfg: GrowerConfig,
                        mesh: jax.sharding.Mesh,
                        axis_name: str = DATA_AXIS,
                        num_class: int = 1,
-                       external_grads: bool = False):
+                       external_grads: bool = False,
+                       efb=None):
     """Build a jitted data-parallel one-iteration training step.
 
     Args:
@@ -80,7 +81,7 @@ def make_dp_train_step(grower_cfg: GrowerConfig,
         tree, node_assign = grow_tree(
             bins, grad, hess, row_weight, fmask,
             fm["num_bins"], fm["default_bins"], fm["nan_bins"],
-            fm["is_categorical"], fm["monotone"], key, cfg)
+            fm["is_categorical"], fm["monotone"], key, cfg, efb=efb)
         delta = tree.leaf_value * learning_rate
         has_split = tree.num_leaves > 1
         return jnp.where(has_split, delta[node_assign], 0.0), tree

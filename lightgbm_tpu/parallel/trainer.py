@@ -28,6 +28,9 @@ Feature coverage mirrors the reference's distributed training
 - **lambdarank / rank_xendcg**: queries are rank-local (the reference's
   distributed contract), gradients are computed per process on its local
   rows and fed to the sharded grower as precomputed inputs;
+- **EFB**: the bundle layout is planned from the pooled binned sample
+  (identical on every rank, io/distributed.py), the shard_map step trains
+  directly in bundle space, and validation traverses unbundled columns;
 - **validation metrics**: additive metrics pool (sum, count); AUC pools
   the raw (score, label) pairs exactly; NDCG@k / MAP@k pool per-query
   means weighted by local query counts.  Early stopping follows the first
@@ -69,9 +72,6 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
     cfg = Config.from_params(dict(params or {}))
     rounds = (num_boost_round if num_boost_round is not None
               else cfg.num_iterations)
-    if jax.process_count() > 1:
-        # v1: the shard_map step runs bins as plain per-feature columns
-        cfg.enable_bundle = False
 
     ds = distributed_dataset(data, cfg, label=label, weight=weight,
                              group=group,
@@ -193,7 +193,7 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
                 monotone=dd.monotone)
 
     step = make_dp_train_step(gcfg, meta, None, cfg.learning_rate, mesh,
-                              num_class=K, external_grads=True)
+                              num_class=K, external_grads=True, efb=dd.efb)
     if K == 1:
         score_l = np.full((per_proc,), inits[0], np.float32)
         score = mk(score_l)

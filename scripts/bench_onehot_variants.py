@@ -1,0 +1,168 @@
+"""One-hot histogram kernel variants — timing shootout on the TPU.
+
+The production kernel (ops/histogram.py:_hist_pallas) is VPU-bound building
+the one-hot (iota-compare-select over f*Bp*BR elements per block; measured
+~12% MFU at the bench shape).  Each variant here changes ONE aspect of the
+one-hot build so the winner can be folded back into the production kernel:
+
+  base      int32 iota compare -> bf16 select (current production shape)
+  bf16cmp   bf16 iota + bf16 bins compare (2-byte lanes may pack 2x)
+  i16cmp    int16 iota + int16 bins compare
+  sub1abs   onehot = max(0, 1 - |b - j|) in bf16 (no select, all-arith)
+  brN       base at BR in {256, 1024, 2048} (VMEM one-hot budget sweep)
+
+Every variant is parity-checked against the XLA one-hot before timing.
+Results append to perf_results.jsonl (stage "onehot_variant").
+
+Run (the ONLY process touching the TPU):
+    python scripts/bench_onehot_variants.py [rows]
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "perf_results.jsonl")
+ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+
+
+def emit(**kv):
+    kv["ts"] = time.time()
+    with open(OUT, "a") as f:
+        f.write(json.dumps(kv) + "\n")
+    print(json.dumps(kv), flush=True)
+
+
+def make_kernel(f, Bp, BR, onehot_fn):
+    """Row-major single-block kernel with a pluggable one-hot builder."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(bins_ref, gh_ref, out_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        b = bins_ref[:].T[:f]                                 # [f, BR] u8
+        onehot = onehot_fn(b, f, Bp, BR).reshape(f * Bp, BR)
+        out_ref[:] += jax.lax.dot_general(
+            gh_ref[:], onehot,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def run(bins, gh6):
+        n = bins.shape[0]
+        assert n % BR == 0
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((6, f * Bp), jnp.float32),
+            grid=(n // BR,),
+            in_specs=[pl.BlockSpec((BR, bins.shape[1]), lambda i: (i, 0)),
+                      pl.BlockSpec((6, BR), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((6, f * Bp), lambda i: (0, 0)),
+        )(bins, gh6)
+    return run
+
+
+def onehot_base(b, f, Bp, BR):
+    import jax
+    import jax.numpy as jnp
+    bi = b.astype(jnp.int32)
+    bin_id = jax.lax.broadcasted_iota(jnp.int32, (f, Bp, BR), 1)
+    return (bi[:, None, :] == bin_id).astype(jnp.bfloat16)
+
+
+def onehot_bf16cmp(b, f, Bp, BR):
+    import jax
+    import jax.numpy as jnp
+    bb = b.astype(jnp.bfloat16)                  # bins < 256: exact in bf16
+    bin_id = jax.lax.broadcasted_iota(jnp.bfloat16, (f, Bp, BR), 1)
+    return (bb[:, None, :] == bin_id).astype(jnp.bfloat16)
+
+
+def onehot_i16cmp(b, f, Bp, BR):
+    import jax
+    import jax.numpy as jnp
+    bi = b.astype(jnp.int16)
+    bin_id = jax.lax.broadcasted_iota(jnp.int16, (f, Bp, BR), 1)
+    return (bi[:, None, :] == bin_id).astype(jnp.bfloat16)
+
+
+def onehot_sub1abs(b, f, Bp, BR):
+    import jax
+    import jax.numpy as jnp
+    bb = b.astype(jnp.bfloat16)
+    bin_id = jax.lax.broadcasted_iota(jnp.bfloat16, (f, Bp, BR), 1)
+    d = bb[:, None, :] - bin_id
+    return jnp.maximum(jnp.bfloat16(1.0) - jnp.abs(d), jnp.bfloat16(0.0))
+
+
+def main():
+    import bench
+    if "axon" in os.environ.get("JAX_PLATFORMS", "axon") \
+            and not bench.probe_backend(
+                float(os.environ.get("BENCH_PROBE_TIMEOUT", 300))):
+        emit(stage="abort", reason="tpu_unreachable")
+        return 1
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from lightgbm_tpu.ops.histogram import _hist_onehot
+
+    N, F, B = ROWS, 28, 255
+    Bp = 256
+    rng = np.random.default_rng(0)
+    bins = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.asarray(np.full(N, 0.25, np.float32))
+    m = jnp.ones(N, jnp.float32)
+    gh = jnp.stack([g * m, h * m, m], axis=0)
+    hi = gh.astype(jnp.bfloat16)
+    lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    gh6 = jnp.concatenate([hi, lo], axis=0)
+
+    ref = jax.jit(lambda b_, g_: _hist_onehot(b_, g_, h, m, B, 65536))(bins, g)
+    ref = ref.block_until_ready()
+
+    peak = bench._PEAK_BF16_FLOPS.get(
+        jax.devices()[0].device_kind.lower(), 197e12)
+    variants = [("base_br512", onehot_base, 512),
+                ("bf16cmp_br512", onehot_bf16cmp, 512),
+                ("i16cmp_br512", onehot_i16cmp, 512),
+                ("sub1abs_br512", onehot_sub1abs, 512),
+                ("base_br256", onehot_base, 256),
+                ("base_br1024", onehot_base, 1024),
+                ("base_br2048", onehot_base, 2048)]
+    for name, fn, BR in variants:
+        try:
+            run = make_kernel(F, Bp, BR, fn)
+            jfn = jax.jit(run)
+            out = jfn(bins, gh6).block_until_ready()
+            hist = (out.reshape(2, 3, F, Bp)[0]
+                    + out.reshape(2, 3, F, Bp)[1])[:, :, :B].transpose(1, 2, 0)
+            err = float(jnp.max(jnp.abs(hist - ref) / (jnp.abs(ref) + 1.0)))
+            if err > 1e-4:
+                emit(stage="onehot_variant", name=name, ok=False, relerr=err)
+                continue
+            t0 = time.perf_counter()
+            for _ in range(10):
+                r = jfn(bins, gh6)
+            r.block_until_ready()
+            dt = (time.perf_counter() - t0) / 10
+            emit(stage="onehot_variant", name=name, ok=True,
+                 ms=round(dt * 1e3, 3),
+                 mfu=round(2.0 * 6 * N * F * Bp / dt / peak, 4))
+        except Exception as e:
+            emit(stage="onehot_variant", name=name, ok=False,
+                 error=str(e)[:250])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,8 +82,12 @@ def main():
     if jax.default_backend() == "tpu":
         try:
             t_pallas = timed(_hist_pallas)
+            Bp = -(-B // 128) * 128
+            peak = bench._PEAK_BF16_FLOPS.get(
+                jax.devices()[0].device_kind.lower(), 197e12)
             emit(stage="hist_pallas", ms=round(t_pallas * 1e3, 3),
-                 grows_per_sec=round(N / t_pallas / 1e9, 3))
+                 grows_per_sec=round(N / t_pallas / 1e9, 3),
+                 mfu=round(2.0 * 6 * N * F * Bp / t_pallas / peak, 4))
         except Exception as e:        # lowering failure must be visible
             emit(stage="hist_pallas", error=str(e)[:300])
     t_onehot = timed(lambda b_, g_, h_, m_, B_: _hist_onehot(

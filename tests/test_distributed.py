@@ -666,3 +666,76 @@ def test_three_process_unequal_shards_with_bagging(tmp_path):
     hashes = sorted(line.split()[-1] for out in outs
                     for line in out.splitlines() if "HASH3" in line)
     assert len(hashes) == 3 and len(set(hashes)) == 1, outs
+
+
+_EFB_WORKER = r"""
+import sys
+import numpy as np
+
+proc_id = int(sys.argv[1]); coord = sys.argv[2]; outdir = sys.argv[3]
+sys.path.insert(0, "@REPO@")
+from lightgbm_tpu.parallel.mesh import init_distributed
+init_distributed(coordinator_address=coord, num_processes=2,
+                 process_id=proc_id)
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.distributed import distributed_dataset
+from lightgbm_tpu.parallel import train_distributed
+
+rng = np.random.default_rng(83)
+n, fd, fs = 3000, 4, 6
+X = np.zeros((n, fd + fs), np.float64)
+X[:, :fd] = rng.normal(size=(n, fd))
+# six mutually exclusive sparse columns (a one-hot-ish block): EFB must
+# bundle them, multi-process included
+cat = rng.integers(-1, fs, size=n)          # -1 = all-zero row
+rows = np.arange(n)[cat >= 0]
+X[rows, fd + cat[cat >= 0]] = rng.uniform(0.5, 2.0, size=len(rows))
+y = (X[:, 0] + 0.8 * (cat == 2) - 0.6 * (cat == 4)
+     + rng.logistic(size=n) * 0.4 > 0).astype(np.float32)
+lo, hi = (0, n // 2) if proc_id == 0 else (n // 2, n)
+
+params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+          "max_bin": 63, "verbose": -1, "seed": 5}
+ds = distributed_dataset(X[lo:hi], Config.from_params(dict(params)),
+                         label=y[lo:hi])
+assert ds.bundles is not None and len(ds.bundles) < fd + fs, ds.bundles
+print("proc{} BUNDLES {}".format(proc_id, len(ds.bundles)))
+
+bst = train_distributed(params, X[lo:hi], y[lo:hi], num_boost_round=6)
+if proc_id == 0:
+    bst.save_model(outdir + "/efb.txt")
+print("proc{} EFBOK".format(proc_id))
+"""
+
+
+def test_two_process_efb_matches_single(tmp_path):
+    """EFB bundling stays ON under multi-process training: the pooled
+    planning sample gives every rank the identical bundle layout
+    (io/distributed.py), the shard_map step trains in bundle space, and
+    the 2-process model equals the single-process model (which bundles
+    the same columns) over the concatenated rows."""
+    import lightgbm_tpu as lgb
+    outs = _run_two_procs(tmp_path, _EFB_WORKER.replace(
+        "sys.argv[3]", f"'{tmp_path}'"), timeout=420)
+    for pid, out in enumerate(outs):
+        assert f"proc{pid} EFBOK" in out, out
+    nb = sorted(line.split()[-1] for out in outs
+                for line in out.splitlines() if "BUNDLES" in line)
+    assert len(set(nb)) == 1, outs
+
+    rng = np.random.default_rng(83)
+    n, fd, fs = 3000, 4, 6
+    X = np.zeros((n, fd + fs), np.float64)
+    X[:, :fd] = rng.normal(size=(n, fd))
+    cat = rng.integers(-1, fs, size=n)
+    rows = np.arange(n)[cat >= 0]
+    X[rows, fd + cat[cat >= 0]] = rng.uniform(0.5, 2.0, size=len(rows))
+    y = (X[:, 0] + 0.8 * (cat == 2) - 0.6 * (cat == 4)
+         + rng.logistic(size=n) * 0.4 > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "max_bin": 63, "verbose": -1, "seed": 5}
+    single = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                       num_boost_round=6)
+    dist = lgb.Booster(model_file=str(tmp_path / "efb.txt"))
+    np.testing.assert_allclose(dist.predict(X), single.predict(X),
+                               rtol=1e-5, atol=1e-6)
