@@ -109,6 +109,23 @@ def _hist_onehot(bins, grad, hess, mask, max_bin, chunk_rows):
     return hist.reshape(3, f, max_bin).transpose(1, 2, 0)
 
 
+def _split_bf16_pair(gh: jax.Array) -> jax.Array:
+    """Split-precision prep for the bf16 histogram matmuls: stack the f32
+    channel rows into (hi, lo) bf16 halves with hi = bf16(x),
+    lo = bf16(x - f32(hi)) so the pair carries ~16 mantissa bits.
+
+    The rounding MUST be fenced with ``optimization_barrier``: under jit,
+    XLA's excess-precision simplification rewrites ``f32(bf16(x))`` back to
+    ``x`` (allowed by ``xla_allow_excess_precision``, default on), which
+    collapses ``lo`` to exactly zero and silently degrades every histogram
+    to bare-bf16 accuracy (relerr ~1e-2 — caught on v5e hardware by
+    ``scripts/bench_dual.py``'s batched-leaf parity gate, round 4; the
+    repro is ``lo == 0`` in-jit but not eagerly)."""
+    hi = jax.lax.optimization_barrier(gh.astype(jnp.bfloat16))
+    lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return jnp.concatenate([hi, lo], axis=0)
+
+
 def build_histogram_leaves(comb: jax.Array, grad: jax.Array, hess: jax.Array,
                            mask: jax.Array, block_leaf: jax.Array,
                            num_slots: int, max_bin: int, *,
@@ -165,19 +182,23 @@ def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
     nb = n // BR
 
     gh = jnp.stack([grad * mask, hess * mask, mask], axis=0).astype(jnp.float32)
-    hi = gh.astype(jnp.bfloat16)
-    lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    gh6 = jnp.concatenate([hi, lo], axis=0)                       # [6, C] bf16
+    gh6 = _split_bf16_pair(gh)                                    # [6, C] bf16
 
     # The WHOLE [num_slots, 6, f*Bp] accumulator rides one constant-index
     # output block: it stays VMEM-resident across the entire grid (k=16
     # slots x 28 feats x 256 bins f32 = 2.8MB) and flushes to HBM once.
     # This zeroes every slot up front — a slot with no row blocks is
-    # well-defined zeros, not stale HBM — and avoids the dynamic output
-    # block index entirely (a [1,6,f*Bp] block keyed on bl[i] silently
-    # dropped the lo-half contributions on real v5e hardware: relerr ~1e-2
-    # vs the ~1e-6 this split-precision design gives; caught by
-    # scripts/bench_dual.py's hardware parity gate, round 4).
+    # well-defined zeros, not stale HBM.  The per-block accumulate routes
+    # through a SLOT ONE-HOT broadcast (sel * acc) rather than any dynamic
+    # index into out_ref: both dynamic-index formulations miscompiled
+    # data-dependently on real v5e hardware (a [1,6,f*Bp] output block
+    # keyed on bl[i], and an out_ref[pl.ds(sl,1)] += store whose 6-sublane
+    # slot slabs are not (8,128)-tile aligned, each dropped the lo-half
+    # bf16-residual contributions for some block_leaf patterns: relerr
+    # ~1.8e-2 vs the ~3e-5 this split-precision design gives — caught twice
+    # by scripts/bench_dual.py's hardware parity gate, round 4).  The
+    # select costs num_slots*6*f*Bp VPU mult-adds per block and benched
+    # FASTER than the aligned dynamic store on v5e.
     def kernel(bl_ref, bins_ref, gh_ref, out_ref):
         i = pl.program_id(0)
 
@@ -193,8 +214,10 @@ def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
             gh_ref[:], onehot,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)                   # [6, f*Bp]
-        sl = bl_ref[i]
-        out_ref[pl.ds(sl, 1)] += acc[None]
+        slot_id = jax.lax.broadcasted_iota(jnp.int32, (num_slots, 1, 1), 0)
+        # where, not sel*acc: 0.0 * inf would leak one bad block's NaNs
+        # into every slot's histogram instead of only its own
+        out_ref[:] += jnp.where(slot_id == bl_ref[i], acc[None], 0.0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -298,9 +321,7 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,
     Bp = -(-B // 128) * 128                      # lane-tile aligned bin width
 
     gh = jnp.stack([grad * mask, hess * mask, mask], axis=0).astype(jnp.float32)
-    hi = gh.astype(jnp.bfloat16)
-    lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    gh6 = jnp.concatenate([hi, lo], axis=0)                       # [6, N] bf16
+    gh6 = _split_bf16_pair(gh)                                    # [6, N] bf16
 
     if f * Bp <= _PALLAS_ROWMAJOR_MAX_LANES:
         # ---- row-major path: one feature block spans all features ----------

@@ -77,3 +77,30 @@ def test_pallas_vs_onehot_parity_tpu():
     b = jax.jit(lambda *x: _hist_onehot(*x, 255, 65536))(bins, g, h, m)
     err = float(jnp.max(jnp.abs(a - b) / (jnp.abs(b) + 1.0)))
     assert err < 1e-4
+
+
+def test_split_bf16_pair_keeps_residual_under_jit():
+    """XLA's excess-precision simplification rewrites f32(bf16(x)) -> x
+    under jit (TPU backend, xla_allow_excess_precision default-on), which
+    collapses the split-precision lo half to zero and degrades every Pallas
+    histogram to bare-bf16 accuracy (relerr ~1e-2; v5e hardware incident,
+    round 4).  Guard both halves: (1) the rounding is fenced by an
+    optimization barrier in the lowered program (the barrier is
+    backend-erasable post-optimization where the rewrite doesn't fire, so
+    only the pre-optimization lowering is assertable on CPU CI; the
+    hardware-truth gate is scripts/bench_dual.py's batched-leaf parity), (2) the in-jit lo equals
+    the eager lo bit-for-bit on this backend."""
+    from lightgbm_tpu.ops.histogram import _split_bf16_pair
+
+    rng = np.random.default_rng(0)
+    gh = jnp.asarray(rng.normal(size=(3, 1024)).astype(np.float32))
+
+    hlo = jax.jit(_split_bf16_pair).lower(gh).as_text()
+    assert "optimization_barrier" in hlo, (
+        "optimization_barrier fencing the bf16 rounding was optimized out "
+        "or removed; the lo residual is not safe under jit")
+
+    got = np.asarray(jax.jit(_split_bf16_pair)(gh))
+    want = np.asarray(_split_bf16_pair(gh))
+    assert np.abs(got[3:].astype(np.float32)).max() > 0.0
+    np.testing.assert_array_equal(got, want)
