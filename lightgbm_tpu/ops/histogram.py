@@ -79,6 +79,14 @@ def _hist_onehot(bins, grad, hess, mask, max_bin, chunk_rows):
     # lands on M (MXU sublane granularity 8) instead of N (lane granularity
     # 128), which benched 2.5x faster on v5e than the [F*B, chunk] @
     # [chunk, 3] orientation (scripts/bench_hist.py).
+    #
+    # precision=HIGHEST: on TPU the DEFAULT matmul precision rounds f32
+    # inputs to bf16 (one MXU pass), which silently degrades this "f32
+    # fallback" to bare-bf16 histograms — measured relerr 0.13 vs the exact
+    # scatter-add on v5e (scripts/debug_bf16_fence2.py).  This path is the
+    # CPU fallback and the accuracy reference for the Pallas kernels, so it
+    # must be truly f32; HIGHEST is a no-op on CPU and costs extra MXU
+    # passes only where this non-hot path runs on TPU.
     n, f = bins.shape
     gh = jnp.stack([grad * mask, hess * mask, mask], axis=0).astype(jnp.float32)  # [3, N]
     chunk = min(chunk_rows, n)
@@ -98,6 +106,7 @@ def _hist_onehot(bins, grad, hess, mask, max_bin, chunk_rows):
         h = jax.lax.dot_general(
             g, onehot,
             dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=jnp.float32)     # [3, F*B]
         return acc + h, None
 
@@ -124,6 +133,13 @@ def _split_bf16_pair(gh: jax.Array) -> jax.Array:
     hi = jax.lax.optimization_barrier(gh.astype(jnp.bfloat16))
     lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
     return jnp.concatenate([hi, lo], axis=0)
+
+
+def _gh6(grad, hess, mask):
+    """Channel prologue shared by the Pallas kernels: stack the three f32
+    channels (g·m, h·m, m) and split each into the bf16 (hi, lo) pair."""
+    gh = jnp.stack([grad * mask, hess * mask, mask], axis=0).astype(jnp.float32)
+    return _split_bf16_pair(gh)
 
 
 def build_histogram_leaves(comb: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -181,8 +197,7 @@ def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
     assert n % BR == 0 and BR % 128 == 0
     nb = n // BR
 
-    gh = jnp.stack([grad * mask, hess * mask, mask], axis=0).astype(jnp.float32)
-    gh6 = _split_bf16_pair(gh)                                    # [6, C] bf16
+    gh6 = _gh6(grad, hess, mask)                                  # [6, C] bf16
 
     # The WHOLE [num_slots, 6, f*Bp] accumulator rides one constant-index
     # output block: it stays VMEM-resident across the entire grid (k=16
@@ -320,8 +335,7 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,
     B = max_bin
     Bp = -(-B // 128) * 128                      # lane-tile aligned bin width
 
-    gh = jnp.stack([grad * mask, hess * mask, mask], axis=0).astype(jnp.float32)
-    gh6 = _split_bf16_pair(gh)                                    # [6, N] bf16
+    gh6 = _gh6(grad, hess, mask)                                  # [6, N] bf16
 
     if f * Bp <= _PALLAS_ROWMAJOR_MAX_LANES:
         # ---- row-major path: one feature block spans all features ----------
