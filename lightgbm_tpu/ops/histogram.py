@@ -157,7 +157,8 @@ def build_histogram_leaves(comb: jax.Array, grad: jax.Array, hess: jax.Array,
     ``F = f_limit or NC`` (the XLA fallback returns all NC columns, trailing
     packed-gradient columns as garbage for the caller to slice).
 
-    The Pallas path re-uses the row-major one-hot MXU kernel with the whole
+    The Pallas path transposes the gathered rows ONCE in XLA and feeds the
+    one-hot MXU kernel ``(f, BR)`` feature-major blocks, with the whole
     ``[num_slots, 6, F*Bp]`` accumulator VMEM-resident for the full grid;
     each row block accumulates into its ``block_leaf``-indexed slot row and
     the buffer flushes to HBM once (the reference GPU kernels' per-workgroup
@@ -198,6 +199,12 @@ def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
     nb = n // BR
 
     gh6 = _gh6(grad, hess, mask)                                  # [6, C] bf16
+    # transpose ONCE in XLA (a fixed ~0.7ms u8 relayout), NOT per block in
+    # the kernel: an in-kernel [BR, f].T benched ~35x slower over a full
+    # pass on v5e — Mosaic lowers the small-tile transpose to lane/sublane
+    # shuffles that dominate the whole kernel (measured 128ms vs 3.7ms at
+    # 1M x 28 x 255, scripts/tpu_perf_suite.py round 4)
+    comb_t = comb[:, :f].T                                        # [f, C] u8
 
     # The WHOLE [num_slots, 6, f*Bp] accumulator rides one constant-index
     # output block: it stays VMEM-resident across the entire grid (k=16
@@ -221,7 +228,7 @@ def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
         def _init():
             out_ref[:] = jnp.zeros_like(out_ref)
 
-        b = bins_ref[:].astype(jnp.int32).T[:f]                   # [f, BR]
+        b = bins_ref[:].astype(jnp.int32)                         # [f, BR]
         bin_id = jax.lax.broadcasted_iota(jnp.int32, (f, Bp, BR), 1)
         onehot = (b[:, None, :] == bin_id).astype(jnp.bfloat16)
         onehot = onehot.reshape(f * Bp, BR)
@@ -237,7 +244,7 @@ def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nb,),
-        in_specs=[pl.BlockSpec((BR, nc), lambda i, bl: (i, 0)),
+        in_specs=[pl.BlockSpec((f, BR), lambda i, bl: (0, i)),
                   pl.BlockSpec((6, BR), lambda i, bl: (0, i))],
         out_specs=pl.BlockSpec((num_slots, 6, f * Bp),
                                lambda i, bl: (0, 0, 0)),
@@ -245,7 +252,7 @@ def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_slots, 6, f * Bp), jnp.float32),
-    )(block_leaf.astype(jnp.int32), comb, gh6)
+    )(block_leaf.astype(jnp.int32), comb_t, gh6)
 
     out = out.reshape(num_slots, 2, 3, f, Bp)
     hist = out[:, 0] + out[:, 1]                                  # hi + lo
@@ -283,7 +290,9 @@ _PALLAS_BLOCK_LANES = 2048
 _PALLAS_ONEHOT_BYTES = 8 * 1024 * 1024
 
 
-# cap so that the 128-row BR floor never busts _PALLAS_ONEHOT_BYTES:
+# cap on single-feature-block kernels (the opt-in rowmajor layout and the
+# batched-leaf kernel, whose bins block spans all f at once) so that the
+# 128-row BR floor never busts _PALLAS_ONEHOT_BYTES:
 # f*Bp*128 bf16 <= 8MiB  =>  f*Bp <= 32768
 _PALLAS_ROWMAJOR_MAX_LANES = 32768
 
@@ -294,11 +303,11 @@ _PALLAS_LEAFACC_BYTES = 48 * 1024 * 1024
 
 
 def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,
-                 f_limit=None):
+                 f_limit=None, layout="featmajor"):
     """Fused histogram: Pallas TPU kernel, bf16 split-precision one-hot matmul.
 
     TPUs have no fast scatter atomics, so the scatter-add is a one-hot matmul
-    on the MXU.  Two design points vs a naive formulation:
+    on the MXU.  The key design point vs a naive formulation:
 
     - **bf16 at f32 accuracy**: the one-hot is exactly representable in bf16,
       and each f32 channel value is split into hi = bf16(x) plus
@@ -306,23 +315,22 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,
       rows (g_hi, h_hi, m_hi, g_lo, h_lo, m_lo) ride the SAME matmul (M <= 8
       sublanes is free) with f32 accumulation, so the whole histogram runs at
       the MXU's bf16 rate — ~4x the f32 rate — with ~1e-5 relative error.
-    Two layouts, chosen by total lane width (Mosaic requires a block's last
-    dim to be a 128-multiple or the full array dim):
+    The default layout is **feature-major blocked**: bins are transposed
+    ONCE in XLA to ``[f_pad, Npad]`` (a fixed ~0.7 ms u8 relayout at the
+    bench shape) and the block is ``(FC, BR)`` — FC on sublanes
+    (8-aligned), BR on lanes (128-aligned) — with grid (feature_blocks,
+    row_blocks), rows minor, so each [6, FC*Bp] output block accumulates
+    in VMEM while the one-hot only ever exists as a [FC*Bp, BR] tile.
 
-    - **row-major single feature block** (``f*Bp <= 32k`` lanes): the bins
-      block is ``(BR, f)`` — legal because ``f`` is the full array width —
-      so bins ride straight from the dataset layout with NO transpose.  (A
-      per-call ``[cap, F] -> [F, cap]`` u8 transpose benched at a fixed
-      ~0.7 ms on v5e regardless of cap — pure relayout latency — which
-      dominated small-segment histograms.)  Grid is (row_blocks,); the
-      [6, f*Bp] output block stays VMEM-resident across all row blocks
-      (TPU grid is sequential -> race-free accumulation).
-    - **feature-major blocked** (wide features, e.g. EFB-bundled data): bins
-      are transposed to ``[f_pad, Npad]`` and the block is ``(FC, BR)`` —
-      FC on sublanes (8-aligned), BR on lanes (128-aligned) — with grid
-      (feature_blocks, row_blocks), rows minor, so each [6, FC*Bp] output
-      block accumulates in VMEM while the one-hot only ever exists as a
-      [FC*Bp, BR] tile.
+    A **row-major** variant (``layout='rowmajor'``, needs ``f*Bp <= 32k``
+    lanes) feeds the dataset layout straight in as ``(BR, f)`` blocks and
+    transposes each tile INSIDE the kernel.  It exists to amortize the
+    fixed external-transpose latency over small per-leaf segments, but on
+    real v5e the in-kernel small-tile transpose lowers to lane/sublane
+    shuffles that cost ~35x the whole feature-major pass at the bench
+    shape (128 ms vs 3.7 ms at 1M x 28 x 255, round-4
+    ``scripts/tpu_perf_suite.py``), so it is opt-in for benchmarking
+    only, never picked automatically.
 
     This replaces the reference's CPU hot loop (``dense_bin.hpp:97-142``) and
     its per-workgroup local-memory GPU kernels
@@ -335,9 +343,16 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,
     B = max_bin
     Bp = -(-B // 128) * 128                      # lane-tile aligned bin width
 
+    if layout not in ("featmajor", "rowmajor"):
+        raise ValueError(f"unknown histogram layout {layout!r}")
+    if layout == "rowmajor" and f * Bp > _PALLAS_ROWMAJOR_MAX_LANES:
+        raise ValueError(
+            f"layout='rowmajor' needs f*Bp <= {_PALLAS_ROWMAJOR_MAX_LANES} "
+            f"lanes (got {f * Bp}); the benchmark comparison would silently "
+            "run the featmajor kernel instead")
     gh6 = _gh6(grad, hess, mask)                                  # [6, N] bf16
 
-    if f * Bp <= _PALLAS_ROWMAJOR_MAX_LANES:
+    if layout == "rowmajor":
         # ---- row-major path: one feature block spans all features ----------
         f_pad = f
         # BR is the bins block's sublane dim AND the gh block's lane dim, so
