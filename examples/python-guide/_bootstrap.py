@@ -1,0 +1,25 @@
+"""Direct-run bootstrap shared by the python-guide examples.
+
+Makes ``python examples/python-guide/<script>.py`` work from a source
+checkout with no install: puts the repo root on ``sys.path`` and pins the
+CPU backend (these are tiny demo datasets; set ``LGBM_GUIDE_BACKEND=tpu``
+to opt into an accelerator).  Under pytest this is a no-op repeat of what
+``tests/conftest.py`` already did.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+if os.environ.get("LGBM_GUIDE_BACKEND", "cpu") == "cpu":
+    # the ambient env may pre-register a remote accelerator backend whose
+    # factory has already read JAX_PLATFORMS; pin the imported config and
+    # drop non-cpu factories so a demo run can never touch hardware
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    import jax._src.xla_bridge as _xb
+    jax.config.update("jax_platforms", "cpu")
+    for _plat in list(_xb._backend_factories):
+        if _plat != "cpu":
+            _xb._backend_factories.pop(_plat, None)

@@ -1,0 +1,69 @@
+"""Advanced workflows (reference analog: examples/python-guide/
+advanced_example.py): sample weights, categorical features, missing values,
+JSON model dump, continued training from ``init_model``, and resetting
+parameters between training stages.
+"""
+import _bootstrap  # noqa: F401  (repo path + CPU backend for direct runs)
+import json
+import os
+import tempfile
+
+import numpy as np
+from sklearn.datasets import make_classification
+
+import lightgbm_tpu as lgb
+
+
+def main():
+    rng = np.random.default_rng(3)
+    X, y = make_classification(n_samples=4000, n_features=12, n_informative=7,
+                               random_state=3)
+    X = X.astype(np.float64)
+    # feature 0 becomes categorical with 6 levels; feature 1 gets missing rows
+    X[:, 0] = rng.integers(0, 6, size=len(X))
+    X[rng.uniform(size=len(X)) < 0.05, 1] = np.nan
+    w = rng.uniform(0.5, 1.5, size=len(X)).astype(np.float64)
+
+    params = {"objective": "binary", "metric": "auc", "num_leaves": 31,
+              "verbose": -1}
+    train_set = lgb.Dataset(X[:3000], label=y[:3000], weight=w[:3000],
+                            categorical_feature=[0], params=params)
+    valid_set = train_set.create_valid(X[3000:], label=y[3000:],
+                                       weight=w[3000:])
+
+    # stage 1: 20 rounds
+    booster = lgb.train(params, train_set, num_boost_round=20,
+                        valid_sets=[valid_set], verbose_eval=False)
+    auc1 = booster.eval_valid()[0][2]
+    print(f"Stage-1 valid AUC after 20 rounds: {auc1:.4f}")
+
+    # inspect the model: JSON dump + per-feature importance
+    dump = booster.dump_model()
+    print(f"Model dump carries {len(dump['tree_info'])} trees; "
+          f"gain importance: {booster.feature_importance('gain')[:4].round(2)}")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "stage1.txt")
+        booster.save_model(path)
+        json_path = os.path.join(tmp, "stage1.json")
+        with open(json_path, "w") as f:
+            json.dump(dump, f)
+
+        # stage 2: continue training 20 more rounds from the saved model,
+        # with a smaller learning rate via reset_parameter
+        params2 = dict(params, learning_rate=0.05)
+        booster2 = lgb.train(
+            params2, train_set, num_boost_round=20, init_model=path,
+            valid_sets=[valid_set],
+            callbacks=[lgb.reset_parameter(
+                learning_rate=lambda it: 0.05 * (0.99 ** it))],
+            verbose_eval=False)
+        auc2 = booster2.eval_valid()[0][2]
+        print(f"Stage-2 valid AUC after 40 total rounds: {auc2:.4f} "
+              f"({booster2.num_trees()} trees)")
+        assert booster2.num_trees() == 40
+        assert auc2 >= auc1 - 0.01
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Built-in ``binary`` objective vs a custom sigmoid-cross-entropy
+``fobj``/``feval`` pair (reference analog: examples/python-guide/
+logistic_regression.py): both train the same task and converge to the same
+AUC, demonstrating the custom-gradient path end to end.
+"""
+import _bootstrap  # noqa: F401  (repo path + CPU backend for direct runs)
+import numpy as np
+from sklearn.datasets import make_classification
+from sklearn.metrics import roc_auc_score
+
+import lightgbm_tpu as lgb
+
+
+def sigmoid(z):
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+def logloss_fobj(preds, train_data):
+    """Gradient/hessian of sigmoid cross-entropy on raw scores."""
+    y = train_data.get_label()
+    p = sigmoid(preds)
+    return p - y, p * (1.0 - p)
+
+
+def logloss_feval(preds, train_data):
+    y = train_data.get_label()
+    p = np.clip(sigmoid(preds), 1e-15, 1.0 - 1e-15)
+    loss = -np.mean(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
+    return "custom_logloss", float(loss), False
+
+
+def main():
+    X, y = make_classification(n_samples=4000, n_features=12, n_informative=8,
+                               random_state=11)
+    X = X.astype(np.float32)
+    y = y.astype(np.float64)
+    Xtr, ytr, Xte, yte = X[:3000], y[:3000], X[3000:], y[3000:]
+    base = {"num_leaves": 31, "learning_rate": 0.1, "verbose": -1}
+
+    built_in = lgb.train({**base, "objective": "binary"},
+                         lgb.Dataset(Xtr, label=ytr), num_boost_round=30)
+    auc_builtin = roc_auc_score(yte, built_in.predict(Xte))
+
+    custom_set = lgb.Dataset(Xtr, label=ytr)
+    custom = lgb.train({**base, "objective": "none"}, custom_set,
+                       num_boost_round=30, fobj=logloss_fobj,
+                       feval=logloss_feval, verbose_eval=False)
+    # custom-objective models emit raw scores; apply the sigmoid ourselves
+    auc_custom = roc_auc_score(yte, sigmoid(custom.predict(Xte)))
+
+    print(f"AUC built-in objective: {auc_builtin:.4f}")
+    print(f"AUC custom fobj:        {auc_custom:.4f}")
+    assert abs(auc_builtin - auc_custom) < 0.02
+
+
+if __name__ == "__main__":
+    main()

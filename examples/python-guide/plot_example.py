@@ -1,0 +1,55 @@
+"""Plotting module walkthrough (reference analog: examples/python-guide/
+plot_example.py): record eval history during training, then render metric
+curves, feature importances, a split-value histogram, and one tree, saving
+all figures as PNGs (Agg backend; no display needed).
+"""
+import _bootstrap  # noqa: F401  (repo path + CPU backend for direct runs)
+import os
+import shutil
+import tempfile
+
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+from sklearn.datasets import make_classification
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import plotting
+
+
+def main():
+    X, y = make_classification(n_samples=3000, n_features=10,
+                               n_informative=6, random_state=5)
+    X = X.astype(np.float32)
+    params = {"objective": "binary", "metric": {"binary_logloss", "auc"},
+              "num_leaves": 15, "verbose": -1}
+    train_set = lgb.Dataset(X[:2200], label=y[:2200], params=params)
+    valid_set = train_set.create_valid(X[2200:], label=y[2200:])
+
+    evals = {}
+    booster = lgb.train(params, train_set, num_boost_round=30,
+                        valid_sets=[train_set, valid_set],
+                        valid_names=["train", "valid"],
+                        callbacks=[lgb.record_evaluation(evals)],
+                        verbose_eval=False)
+
+    with tempfile.TemporaryDirectory(prefix="lgb_plots_") as out:
+        ax = plotting.plot_metric(evals, metric="auc")
+        ax.figure.savefig(os.path.join(out, "metric.png"))
+        ax = plotting.plot_importance(booster, max_num_features=8)
+        ax.figure.savefig(os.path.join(out, "importance.png"))
+        ax = plotting.plot_split_value_histogram(booster, feature=0)
+        ax.figure.savefig(os.path.join(out, "split_hist.png"))
+        expected = 3
+        if shutil.which("dot"):   # tree rendering needs graphviz installed
+            ax = plotting.plot_tree(booster, tree_index=0)
+            ax.figure.savefig(os.path.join(out, "tree0.png"))
+            expected = 4
+        made = sorted(os.listdir(out))
+        print(f"Wrote {len(made)} figures: {made}")
+        assert len(made) == expected
+
+
+if __name__ == "__main__":
+    main()

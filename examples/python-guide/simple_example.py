@@ -1,0 +1,61 @@
+"""Train/validate/predict round trip through the core Python API.
+
+The entry-level workflow (reference analog: examples/python-guide/
+simple_example.py): build ``Dataset``s, train with early stopping against a
+validation set, predict, and persist the model as LightGBM-format text.
+"""
+import _bootstrap  # noqa: F401  (repo path + CPU backend for direct runs)
+import os
+import tempfile
+
+import numpy as np
+from sklearn.datasets import make_regression
+from sklearn.model_selection import train_test_split
+
+import lightgbm_tpu as lgb
+
+
+def main():
+    X, y = make_regression(n_samples=4000, n_features=20, n_informative=12,
+                           noise=8.0, random_state=7)
+    X_train, X_test, y_train, y_test = train_test_split(
+        X.astype(np.float32), y.astype(np.float32), random_state=7)
+
+    params = {
+        "objective": "regression",
+        "metric": {"l2", "l1"},
+        "num_leaves": 31,
+        "learning_rate": 0.08,
+        "feature_fraction": 0.9,
+        "bagging_fraction": 0.8,
+        "bagging_freq": 5,
+        "verbose": -1,
+    }
+    train_set = lgb.Dataset(X_train, label=y_train, params=params)
+    valid_set = train_set.create_valid(X_test, label=y_test)
+
+    print("Starting training...")
+    evals = {}
+    booster = lgb.train(
+        params, train_set, num_boost_round=60,
+        valid_sets=[valid_set], valid_names=["valid"],
+        callbacks=[lgb.early_stopping(stopping_rounds=8),
+                   lgb.record_evaluation(evals)],
+        verbose_eval=False)
+    print(f"Best iteration: {booster.best_iteration}; "
+          f"valid l2 history tail: {evals['valid']['l2'][-3:]}")
+
+    pred = booster.predict(X_test, num_iteration=booster.best_iteration)
+    rmse = float(np.sqrt(np.mean((pred - y_test) ** 2)))
+    print(f"RMSE on held-out data: {rmse:.4f}")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "model.txt")
+        booster.save_model(path)
+        reloaded = lgb.Booster(model_file=path)
+        assert np.allclose(reloaded.predict(X_test), pred, atol=1e-6)
+        print(f"Model round-trips through {os.path.basename(path)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""The sklearn estimator surface (reference analog: examples/python-guide/
+sklearn_example.py): fit with eval sets + early stopping, inspect feature
+importances, and run a hyper-parameter grid search with the stock sklearn
+machinery (the wrappers are sklearn-compatible estimators).
+"""
+import _bootstrap  # noqa: F401  (repo path + CPU backend for direct runs)
+import numpy as np
+from sklearn.datasets import make_regression
+from sklearn.model_selection import GridSearchCV, train_test_split
+
+from lightgbm_tpu.sklearn import LGBMRegressor
+
+
+def main():
+    X, y = make_regression(n_samples=3000, n_features=15, n_informative=8,
+                           noise=10.0, random_state=1)
+    X_train, X_test, y_train, y_test = train_test_split(
+        X.astype(np.float32), y.astype(np.float32), random_state=1)
+
+    model = LGBMRegressor(num_leaves=31, learning_rate=0.08,
+                          n_estimators=50, verbose=-1)
+    model.fit(X_train, y_train,
+              eval_set=[(X_test, y_test)], eval_metric="l1",
+              early_stopping_rounds=8, verbose=False)
+    pred = model.predict(X_test, num_iteration=model.best_iteration_)
+    rmse = float(np.sqrt(np.mean((pred - y_test) ** 2)))
+    print(f"RMSE: {rmse:.4f} (best iteration {model.best_iteration_})")
+
+    order = np.argsort(model.feature_importances_)[::-1][:5]
+    print("Top-5 features by split importance:", order.tolist())
+
+    search = GridSearchCV(
+        LGBMRegressor(n_estimators=25, verbose=-1),
+        {"learning_rate": [0.05, 0.1], "num_leaves": [15, 31]},
+        cv=3)
+    search.fit(X_train, y_train)
+    print("Best grid-search params:", search.best_params_)
+
+
+if __name__ == "__main__":
+    main()

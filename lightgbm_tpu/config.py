@@ -384,6 +384,10 @@ class Config:
                 return out
             if isinstance(value, (list, tuple)):
                 return list(value)
+            if isinstance(value, (set, frozenset)):
+                # sets are legal param values (reference param_dict_to_str
+                # accepts them); sort for a deterministic metric order
+                return sorted(value, key=str)
             return [value]
         return value
 

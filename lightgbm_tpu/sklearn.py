@@ -17,6 +17,22 @@ from .basic import Booster, Dataset
 from .engine import train
 from .utils.log import LightGBMError
 
+try:
+    # real sklearn bases when available: estimator tags (__sklearn_tags__,
+    # required by sklearn>=1.6 meta-estimators like GridSearchCV), clone()
+    # and repr support all ride the official protocol
+    from sklearn.base import BaseEstimator as _SKLBase
+    from sklearn.base import ClassifierMixin as _SKLClassifierMixin
+    from sklearn.base import RegressorMixin as _SKLRegressorMixin
+except ImportError:                                  # sklearn is optional
+    _SKLBase = object
+
+    class _SKLClassifierMixin:
+        pass
+
+    class _SKLRegressorMixin:
+        pass
+
 __all__ = ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
 
 
@@ -58,7 +74,7 @@ class _EvalFunctionWrapper:
         raise TypeError(f"Self-defined eval function should have 2-4 arguments, got {argc}")
 
 
-class LGBMModel:
+class LGBMModel(_SKLBase):
     """Base sklearn estimator (reference ``sklearn.py:180``)."""
 
     def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
@@ -283,7 +299,7 @@ class LGBMModel:
         return self.fitted_
 
 
-class LGBMRegressor(LGBMModel):
+class LGBMRegressor(_SKLRegressorMixin, LGBMModel):
     """LightGBM regressor (reference ``sklearn.py:780``)."""
 
     def __init__(self, **kwargs):
@@ -299,7 +315,7 @@ class LGBMRegressor(LGBMModel):
         return r2_score(y, self.predict(X), sample_weight=sample_weight)
 
 
-class LGBMClassifier(LGBMModel):
+class LGBMClassifier(_SKLClassifierMixin, LGBMModel):
     """LightGBM classifier (reference ``sklearn.py:806``)."""
 
     def fit(self, X, y, **kwargs):

@@ -71,13 +71,18 @@ def main():
     h = jnp.asarray(np.full(N, 0.25, np.float32))
     m = jnp.ones(N, jnp.float32)
 
-    def timed(fn, iters=10):
-        jfn = jax.jit(lambda b_, g_: jnp.sum(fn(b_, g_, h, m, B)))
-        float(jfn(bins, g))
+    def timed_jfn(jfn, mk_args, iters=10):
+        """Warm once, then average ``iters`` timed calls; ``mk_args(eps)``
+        builds the call args with a gradient cache-buster perturbation."""
+        float(jfn(*mk_args(0.0)))
         t = time.perf_counter()
         for _ in range(iters):
-            float(jfn(bins, g + 1e-12))
+            float(jfn(*mk_args(1e-12)))
         return (time.perf_counter() - t) / iters
+
+    def timed(fn, iters=10):
+        jfn = jax.jit(lambda b_, g_: jnp.sum(fn(b_, g_, h, m, B)))
+        return timed_jfn(jfn, lambda eps: (bins, g + eps), iters)
 
     if jax.default_backend() == "tpu":
         try:
@@ -90,6 +95,21 @@ def main():
                  mfu=round(2.0 * 6 * N * F * Bp / t_pallas / peak, 4))
         except Exception as e:        # lowering failure must be visible
             emit(stage="hist_pallas", error=str(e)[:300])
+        # batched-leaf kernel at the frontier shape: same rows split over
+        # 16 slots in 512-row blocks (the per-round frontier workload)
+        try:
+            from lightgbm_tpu.ops.histogram import _hist_leaves_pallas
+            BRL, KSL = 512, 16
+            nbl = N // BRL
+            bl = jnp.asarray((np.arange(nbl) * KSL // nbl).astype(np.int32))
+            jfn = jax.jit(lambda b_, g_: jnp.sum(_hist_leaves_pallas(
+                b_, g_, h[:nbl * BRL], m[:nbl * BRL], bl, KSL, B, BRL, F)))
+            t_leaves = timed_jfn(
+                jfn, lambda eps: (bins[:nbl * BRL], g[:nbl * BRL] + eps))
+            emit(stage="hist_leaves_pallas", ms=round(t_leaves * 1e3, 3),
+                 slots=KSL, block_rows=BRL)
+        except Exception as e:
+            emit(stage="hist_leaves_pallas", error=str(e)[:300])
     t_onehot = timed(lambda b_, g_, h_, m_, B_: _hist_onehot(
         b_, g_, h_, m_, B_, 65536))
     emit(stage="hist_onehot", ms=round(t_onehot * 1e3, 3))
@@ -133,7 +153,7 @@ def main():
     best = (None, float("inf"))
     # frontier_k sweep: the batch width trades per-round fixed cost against
     # block-padding waste — pick the winner for the headline bench
-    for fk, br in ((16, 512), (32, 512), (8, 512), (16, 1024)):
+    for fk, br in ((32, 512), (16, 512), (64, 512), (32, 1024)):
         cfg_m = cfg._replace(grower_mode="frontier", frontier_k=fk,
                              frontier_block_rows=br)
         ms = time_grow(cfg_m, f"frontier_k{fk}_br{br}", iters=4)

@@ -1,0 +1,17 @@
+"""Every python-guide example must run clean end to end (the reference runs
+its examples in CI the same way; see examples/python-guide/README.md)."""
+import os
+import runpy
+
+import pytest
+
+_GUIDE = os.path.join(os.path.dirname(__file__), os.pardir,
+                      "examples", "python-guide")
+_SCRIPTS = sorted(f for f in os.listdir(_GUIDE) if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("script", _SCRIPTS)
+def test_python_guide_example_runs(script):
+    if script == "plot_example.py":
+        pytest.importorskip("matplotlib")
+    runpy.run_path(os.path.join(_GUIDE, script), run_name="__main__")
