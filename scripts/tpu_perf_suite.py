@@ -102,10 +102,13 @@ def main():
             BRL, KSL = 512, 16
             nbl = N // BRL
             bl = jnp.asarray((np.arange(nbl) * KSL // nbl).astype(np.int32))
+            # slice ONCE outside the timed loop so the number is comparable
+            # to hist_pallas (a per-call 28MB device copy would skew it)
+            bins_l, g_l = bins[:nbl * BRL], g[:nbl * BRL]
+            h_l, m_l = h[:nbl * BRL], m[:nbl * BRL]
             jfn = jax.jit(lambda b_, g_: jnp.sum(_hist_leaves_pallas(
-                b_, g_, h[:nbl * BRL], m[:nbl * BRL], bl, KSL, B, BRL, F)))
-            t_leaves = timed_jfn(
-                jfn, lambda eps: (bins[:nbl * BRL], g[:nbl * BRL] + eps))
+                b_, g_, h_l, m_l, bl, KSL, B, BRL, F)))
+            t_leaves = timed_jfn(jfn, lambda eps: (bins_l, g_l + eps))
             emit(stage="hist_leaves_pallas", ms=round(t_leaves * 1e3, 3),
                  slots=KSL, block_rows=BRL)
         except Exception as e:

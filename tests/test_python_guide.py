@@ -2,12 +2,19 @@
 its examples in CI the same way; see examples/python-guide/README.md)."""
 import os
 import runpy
+import sys
 
 import pytest
 
 _GUIDE = os.path.join(os.path.dirname(__file__), os.pardir,
                       "examples", "python-guide")
-_SCRIPTS = sorted(f for f in os.listdir(_GUIDE) if f.endswith(".py"))
+# runpy.run_path does NOT put the script's directory on sys.path (unlike a
+# direct `python script.py` run), so the examples' `import _bootstrap`
+# needs it added here
+if _GUIDE not in sys.path:
+    sys.path.insert(0, _GUIDE)
+_SCRIPTS = sorted(f for f in os.listdir(_GUIDE)
+                  if f.endswith(".py") and f != "_bootstrap.py")
 
 
 @pytest.mark.parametrize("script", _SCRIPTS)
