@@ -42,6 +42,9 @@ class Dataset:
         self._inner: Optional[_InnerDataset] = None
         self.used_indices: Optional[np.ndarray] = None
         self._predictor = None
+        # per-categorical-column category lists for pandas inputs (reference
+        # pandas_categorical, basic.py:391); filled at construct time
+        self.pandas_categorical = None
 
     # ------------------------------------------------------------------
     def construct(self) -> "Dataset":
@@ -76,6 +79,21 @@ class Dataset:
             if self.init_score is None and _os.path.exists(path + ".init"):
                 self.init_score = np.loadtxt(path + ".init", dtype=np.float64,
                                              ndmin=1)
+        from .io.dataset import _is_dataframe
+        if _is_dataframe(data):
+            from .io.dataset import _pandas_to_numpy
+            if self.reference is not None:
+                # the reference owns the category lists; make sure it is
+                # constructed BEFORE they are read (an early-constructed
+                # valid set must not code against its own levels)
+                self.reference.construct()
+            ref_pc = (self.reference.pandas_categorical
+                      if self.reference is not None else None)
+            data, df_names, cat_spec, self.pandas_categorical = \
+                _pandas_to_numpy(data, self.categorical_feature, ref_pc)
+            if self.feature_name == "auto":
+                self.feature_name = df_names
+            self.categorical_feature = cat_spec
         feature_names = None if self.feature_name == "auto" else list(self.feature_name)
         cats = None
         if self.categorical_feature != "auto":
@@ -284,11 +302,13 @@ class Booster:
         self.best_iteration = -1
         self.best_score: Dict[str, Dict[str, float]] = {}
         self._network_initialized = False
+        self.pandas_categorical = None
         if train_set is not None:
             check(isinstance(train_set, Dataset), "training data should be Dataset instance")
             cfg = Config.from_params(self.params)
             train_set.params = dict(self.params)
             train_set.construct()
+            self.pandas_categorical = train_set.pandas_categorical
             self._gbdt = self._create_engine(cfg, train_set._inner)
             self.name_valid_sets: List[str] = []
         elif model_file is not None:
@@ -310,6 +330,7 @@ class Booster:
     def _load_from_string(self, model_str: str) -> None:
         self._gbdt = model_io.load_model_from_string(model_str, GBDT)
         self.name_valid_sets = []
+        self.pandas_categorical = model_io.parse_pandas_categorical(model_str)
 
     # ------------------------------------------------------------------
     def add_valid(self, data: Dataset, name: str) -> "Booster":
@@ -587,9 +608,26 @@ class Booster:
                 data = np.pad(data,
                               ((0, 0),
                                (0, self.num_feature() - data.shape[1])))
-        if hasattr(data, "values"):
+        from .io.dataset import _is_dataframe, _is_sparse
+        if _is_dataframe(data):
+            from .io.dataset import _pandas_to_numpy
+            import pandas as pd
+            pc = getattr(self, "pandas_categorical", None)
+            has_cats = any(isinstance(dt, pd.CategoricalDtype)
+                           for dt in data.dtypes)
+            if has_cats and pc is None:
+                # silently re-deriving codes from the prediction frame's
+                # own level order would misalign with training (the
+                # reference raises here too)
+                raise LightGBMError(
+                    "cannot predict on a DataFrame with category-dtype "
+                    "columns: the model carries no pandas_categorical "
+                    "mapping (it was not trained on a pandas DataFrame)")
+            # re-code category columns against the TRAINING category lists
+            # (unseen values -> NaN), like the reference's predictor
+            data = _pandas_to_numpy(data, "auto", pc)[0]
+        elif hasattr(data, "values"):
             data = data.values
-        from .io.dataset import _is_sparse
         in_fmt = getattr(data, "format", None) if _is_sparse(data) else None
         if _is_sparse(data):   # scipy.sparse: block-densified predict
             data = data.tocsr()
@@ -629,9 +667,14 @@ class Booster:
                 "saved_feature_importance_type", 0)) == 1 else "split")
         if num_iteration is None:
             num_iteration = self.best_iteration      # reference default
-        return model_io.save_model_to_string(
+        text = model_io.save_model_to_string(
             self._gbdt, num_iteration, start_iteration,
             1 if importance_type == "gain" else 0)
+        # trailing pandas_categorical line exactly like the reference
+        # python package appends (basic.py _dump_pandas_categorical:445);
+        # the reference C++ text parser ignores it, so interop is kept
+        return text + model_io.format_pandas_categorical(
+            getattr(self, "pandas_categorical", None))
 
     def dump_model(self, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> dict:
@@ -647,6 +690,9 @@ class Booster:
             "max_feature_idx": g.max_feature_idx,
             "objective": g.config.objective,
             "feature_names": (g.train_data.feature_names if g.train_data else []),
+            # reference dump carries the pandas category lists too
+            # (Booster.dump_model, python-package/lightgbm/basic.py)
+            "pandas_categorical": getattr(self, "pandas_categorical", None),
             "tree_info": [dict(tree_index=i, **t.to_json()) for i, t in enumerate(models)],
         }
 

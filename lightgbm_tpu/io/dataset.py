@@ -598,6 +598,74 @@ def _to_2d_float(data) -> np.ndarray:
     return np.ascontiguousarray(arr, dtype=np.float64)
 
 
+def _is_dataframe(data) -> bool:
+    """True for a pandas DataFrame without importing pandas eagerly."""
+    return hasattr(data, "dtypes") and hasattr(data, "columns") \
+        and hasattr(data, "values")
+
+
+def _pandas_to_numpy(df, categorical_feature="auto", pandas_categorical=None):
+    """Convert a pandas DataFrame to the float64 matrix the binner ingests
+    (the analog of the reference's ``_data_from_pandas``,
+    ``python-package/lightgbm/basic.py:391``).
+
+    ``category``-dtype columns are encoded as their category CODES (float,
+    missing -> NaN) against a per-column category list:
+
+    - training (``pandas_categorical is None``): the lists are taken from
+      the DataFrame and returned, to be stored on the Booster and persisted
+      in the model file, and the categorical columns are auto-added to
+      ``categorical_feature`` when that is ``"auto"``;
+    - validation/prediction: the caller passes the stored lists and values
+      are re-coded against THEM, so a frame whose categorical levels differ
+      (fewer seen, different order) still maps to the training codes;
+      values outside the stored list become NaN (missing).
+
+    Returns ``(arr, feature_names, categorical_feature, pandas_categorical)``.
+    """
+    import pandas as pd
+
+    names = [str(c) for c in df.columns]
+    cat_pos = [j for j, c in enumerate(df.columns)
+               if isinstance(df.dtypes.iloc[j], pd.CategoricalDtype)]
+    bad_cols = [names[j] for j in range(df.shape[1])
+                if j not in cat_pos
+                and not pd.api.types.is_numeric_dtype(df.dtypes.iloc[j])
+                and not pd.api.types.is_bool_dtype(df.dtypes.iloc[j])]
+    if bad_cols:
+        raise ValueError(
+            f"DataFrame column(s) {bad_cols} have a non-numeric (object/"
+            "string/datetime) dtype; cast them to a numeric or category "
+            "dtype first")
+    if not cat_pos and not pandas_categorical:
+        # all-numeric frame: one bulk conversion (the predict hot path)
+        return (np.ascontiguousarray(df.to_numpy(dtype=np.float64)),
+                names, categorical_feature, pandas_categorical)
+    if pandas_categorical is None:
+        pandas_categorical = [list(df.iloc[:, j].cat.categories)
+                              for j in cat_pos]
+    else:
+        check(len(cat_pos) == len(pandas_categorical),
+              "DataFrame categorical columns do not match the training "
+              f"data ({len(cat_pos)} vs {len(pandas_categorical)})")
+
+    arr = np.empty((len(df), df.shape[1]), dtype=np.float64)
+    for j in range(df.shape[1]):
+        col = df.iloc[:, j]
+        if j in cat_pos:
+            cats = pandas_categorical[cat_pos.index(j)]
+            codes = col.cat.set_categories(cats).cat.codes.to_numpy()
+            vals = codes.astype(np.float64)
+            vals[codes < 0] = np.nan          # unseen/missing -> missing
+        else:
+            vals = col.to_numpy().astype(np.float64)
+        arr[:, j] = vals
+
+    if categorical_feature == "auto":
+        categorical_feature = list(cat_pos) if cat_pos else "auto"
+    return arr, names, categorical_feature, pandas_categorical
+
+
 def _resolve_categorical(categorical_feature, feature_names: List[str], config: Config) -> List[int]:
     spec = categorical_feature if categorical_feature is not None else config.categorical_feature
     if spec is None or spec == "" or spec == "auto":

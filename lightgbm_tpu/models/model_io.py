@@ -93,6 +93,37 @@ def _objective_string(cfg: Config) -> str:
     return s
 
 
+def format_pandas_categorical(pandas_categorical) -> str:
+    """Trailing ``pandas_categorical:<json>`` line, the same format the
+    reference python package appends after the C++ model text
+    (``basic.py _dump_pandas_categorical:445``); the reference's text
+    parser ignores trailing content, so files stay interoperable."""
+    import json
+
+    def _default(o):
+        if isinstance(o, np.generic):
+            return o.item()
+        raise TypeError(f"cannot serialize {type(o).__name__}")
+
+    return ("\npandas_categorical:"
+            + json.dumps(pandas_categorical, default=_default) + "\n")
+
+
+def parse_pandas_categorical(text: str):
+    """Recover the category lists from a saved model's trailing line
+    (reference ``_load_pandas_categorical``, ``basic.py:455``)."""
+    import json
+    tag = "pandas_categorical:"
+    pos = text.rfind("\n" + tag)
+    if pos < 0:
+        return None
+    payload = text[pos + 1 + len(tag):].splitlines()[0]
+    try:
+        return json.loads(payload)
+    except json.JSONDecodeError:
+        return None
+
+
 def load_model_from_string(text: str, gbdt_cls, config: Optional[Config] = None):
     """Parse a model file (reference ``GBDT::LoadModelFromString``,
     ``gbdt_model_text.cpp:416``)."""

@@ -73,6 +73,27 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
     rounds = (num_boost_round if num_boost_round is not None
               else cfg.num_iterations)
 
+    from ..io.dataset import _is_dataframe
+    pandas_categorical = None
+    if _is_dataframe(data):
+        # category-dtype columns -> training codes, like Dataset.construct;
+        # the category lists ride to the returned Booster so predict on a
+        # DataFrame re-codes against them.  NOTE: the lists come from THIS
+        # process's shard — with category dtypes the caller must use
+        # identical dtypes on every rank (same levels, same order), which
+        # pandas enforces naturally when shards come from one parent frame.
+        from ..io.dataset import _pandas_to_numpy
+        data, df_names, cat_spec, pandas_categorical = _pandas_to_numpy(
+            data, categorical_feature if categorical_feature is not None
+            else "auto", None)
+        feature_name = feature_name or df_names
+        categorical_feature = None if cat_spec == "auto" else cat_spec
+    if valid_data is not None and _is_dataframe(valid_data[0]):
+        from ..io.dataset import _pandas_to_numpy
+        valid_data = (_pandas_to_numpy(valid_data[0], "auto",
+                                       pandas_categorical)[0],
+                      valid_data[1])
+
     ds = distributed_dataset(data, cfg, label=label, weight=weight,
                              group=group,
                              categorical_feature=categorical_feature,
@@ -81,6 +102,7 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
         from ..basic import Booster, Dataset
         wrapper = Dataset(None, params=dict(params or {}))
         wrapper._inner = ds
+        wrapper.pandas_categorical = pandas_categorical
         valid_sets = None
         if valid_data is not None:
             vw = Dataset(valid_data[0], label=valid_data[1],
@@ -376,6 +398,7 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
     from ..models import model_io
     from ..basic import Booster
     bst = Booster(model_str=model_io.save_model_to_string(gbdt))
+    bst.pandas_categorical = pandas_categorical
     if history and early_stopping_rounds:
         bst.best_iteration = best_iter_num     # sklearn/num_iteration hooks
     return bst

@@ -12,7 +12,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from .io.dataset import _is_sparse
+from .io.dataset import _is_dataframe, _is_sparse
 from .basic import Booster, Dataset
 from .engine import train
 from .utils.log import LightGBMError
@@ -171,7 +171,9 @@ class LGBMModel(_SKLBase):
             eval_group=None, eval_metric=None, early_stopping_rounds=None,
             feature_name="auto", categorical_feature="auto", callbacks=None,
             verbose: Any = False):
-        if not _is_sparse(X):
+        if not _is_sparse(X) and not _is_dataframe(X):
+            # DataFrames pass through untouched so Dataset's pandas path
+            # (category-dtype -> codes, auto feature names) applies
             X = np.asarray(X, dtype=np.float64)
         y = np.asarray(y).ravel()
         self._n_features = X.shape[1]
@@ -230,7 +232,7 @@ class LGBMModel(_SKLBase):
                 num_iteration: Optional[int] = None, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs):
         self._check_fitted()
-        if not _is_sparse(X):
+        if not _is_sparse(X) and not _is_dataframe(X):
             X = np.asarray(X, dtype=np.float64)
         if X.shape[1] != self._n_features:
             raise LightGBMError(

@@ -1,0 +1,182 @@
+"""pandas DataFrame ingestion: category-dtype columns -> training codes,
+auto feature names, persisted pandas_categorical, predict-time re-coding
+(reference ``_data_from_pandas`` / ``_dump_pandas_categorical``,
+``python-package/lightgbm/basic.py:391,445``)."""
+import numpy as np
+import pytest
+
+pd = pytest.importorskip("pandas")
+
+import lightgbm_tpu as lgb
+
+
+def _frame(n=800, seed=0):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "num0": rng.normal(size=n),
+        "color": pd.Categorical(rng.choice(["red", "green", "blue"], n)),
+        "num1": rng.normal(size=n),
+        "size": pd.Categorical(rng.choice(["s", "m", "l", "xl"], n)),
+    })
+    logit = (df["num0"].to_numpy()
+             + (df["color"] == "green") * 1.5
+             + (df["size"].isin(["l", "xl"])) * 1.0
+             + 0.5 * df["num1"].to_numpy())
+    y = (logit + rng.logistic(size=n) > 1.0).astype(np.float64)
+    return df, y
+
+
+_PARAMS = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+           "min_data_in_leaf": 5}
+
+
+def _codes_matrix(df):
+    out = np.empty(df.shape, np.float64)
+    for j, c in enumerate(df.columns):
+        col = df[c]
+        if isinstance(col.dtype, pd.CategoricalDtype):
+            codes = col.cat.codes.to_numpy().astype(np.float64)
+            codes[codes < 0] = np.nan
+            out[:, j] = codes
+        else:
+            out[:, j] = col.to_numpy()
+    return out
+
+
+def test_dataframe_train_matches_manual_codes():
+    df, y = _frame()
+    bst = lgb.train(_PARAMS, lgb.Dataset(df, label=y), 15)
+    assert bst.feature_name() == ["num0", "color", "num1", "size"]
+
+    manual = lgb.train(_PARAMS, lgb.Dataset(
+        _codes_matrix(df), label=y, categorical_feature=[1, 3],
+        feature_name=["num0", "color", "num1", "size"]), 15)
+    np.testing.assert_allclose(bst.predict(df), manual.predict(_codes_matrix(df)),
+                               rtol=1e-12)
+
+
+def test_pandas_categorical_roundtrip_and_recoding(tmp_path):
+    df, y = _frame()
+    bst = lgb.train(_PARAMS, lgb.Dataset(df, label=y), 15)
+    base = bst.predict(df)
+
+    path = tmp_path / "model.txt"
+    bst.save_model(str(path))
+    text = path.read_text()
+    assert "\npandas_categorical:" in text
+    loaded = lgb.Booster(model_file=str(path))
+    assert loaded.pandas_categorical == bst.pandas_categorical
+    np.testing.assert_allclose(loaded.predict(df), base, rtol=1e-12)
+
+    # a frame with a DIFFERENT level order/subset must re-code against the
+    # stored training lists, not its own
+    df2 = df.copy()
+    df2["color"] = df2["color"].cat.reorder_categories(
+        ["blue", "red", "green"])
+    np.testing.assert_allclose(loaded.predict(df2), base, rtol=1e-12)
+
+
+def test_unseen_category_is_missing():
+    df, y = _frame()
+    bst = lgb.train(_PARAMS, lgb.Dataset(df, label=y), 15)
+    df2 = df.head(50).copy()
+    df2["color"] = pd.Categorical(["purple"] * 50,
+                                  categories=["purple", "red"])
+    df_nan = df.head(50).copy()
+    codes = _codes_matrix(df_nan)
+    codes[:, 1] = np.nan
+    np.testing.assert_allclose(bst.predict(df2), bst.predict(codes),
+                               rtol=1e-12)
+
+
+def test_valid_set_uses_training_categories():
+    df, y = _frame()
+    train = lgb.Dataset(df.head(600), label=y[:600], params=_PARAMS)
+    # validation frame that happens to only SEE two colors: its codes must
+    # still follow the training lists
+    dfv = df.tail(200).copy()
+    dfv["color"] = dfv["color"].cat.remove_unused_categories() \
+        if dfv["color"].nunique() < 3 else dfv["color"]
+    valid = train.create_valid(dfv, label=y[600:])
+    bst = lgb.train(_PARAMS, train, 10, valid_sets=[valid],
+                    verbose_eval=False)
+    assert bst.eval_valid()[0][2] > 0.5      # AUC-ish sanity via metric
+
+
+def test_object_dtype_raises():
+    df, y = _frame()
+    df = df.copy()
+    df["bad"] = ["a"] * len(df)
+    with pytest.raises(ValueError, match="non-numeric"):
+        lgb.Dataset(df, label=y).construct()
+
+
+def test_all_numeric_frame_bulk_path():
+    rng = np.random.default_rng(1)
+    df = pd.DataFrame(rng.normal(size=(300, 4)),
+                      columns=["a", "b", "c", "d"])
+    y = (df["a"] > 0).astype(float)
+    bst = lgb.train(_PARAMS, lgb.Dataset(df, label=y), 5)
+    np.testing.assert_allclose(bst.predict(df), bst.predict(df.to_numpy()),
+                               rtol=1e-12)
+    with pytest.raises(ValueError, match="non-numeric"):
+        bad = df.copy()
+        bad["e"] = ["x"] * len(df)
+        bst.predict(bad)
+
+
+def test_predict_cat_frame_without_mapping_raises():
+    df, y = _frame()
+    bst = lgb.train(_PARAMS, lgb.Dataset(_codes_matrix(df), label=y,
+                                         categorical_feature=[1, 3]), 10)
+    with pytest.raises(lgb.LightGBMError, match="pandas_categorical"):
+        bst.predict(df)
+
+
+def test_early_constructed_valid_set_uses_training_categories():
+    df, y = _frame()
+    train = lgb.Dataset(df.head(600), label=y[:600], params=_PARAMS)
+    dfv = df.tail(200).copy()
+    dfv["color"] = dfv["color"].cat.reorder_categories(
+        ["blue", "red", "green"])
+    valid = train.create_valid(dfv, label=y[600:])
+    valid.construct()          # BEFORE the training set is constructed
+    bst = lgb.train(_PARAMS, train, 10, valid_sets=[valid],
+                    verbose_eval=False)
+    # the re-ordered valid frame must be coded against the TRAINING lists:
+    # its eval must equal an identical frame with the original ordering
+    valid2 = train.create_valid(df.tail(200), label=y[600:])
+    bst2 = lgb.train(_PARAMS, train, 10, valid_sets=[valid2],
+                     verbose_eval=False)
+    assert bst.eval_valid()[0][2] == pytest.approx(bst2.eval_valid()[0][2],
+                                                   rel=1e-12)
+
+
+def test_dump_model_carries_pandas_categorical():
+    df, y = _frame()
+    bst = lgb.train(_PARAMS, lgb.Dataset(df, label=y), 5)
+    dump = bst.dump_model()
+    assert dump["pandas_categorical"] == bst.pandas_categorical
+    assert dump["pandas_categorical"][0] == ["blue", "green", "red"]
+
+
+def test_train_distributed_single_process_dataframe():
+    from lightgbm_tpu.parallel.trainer import train_distributed
+    df, y = _frame()
+    bst = train_distributed(_PARAMS, df, y, num_boost_round=8)
+    p1 = bst.predict(df)
+    df2 = df.copy()
+    df2["color"] = df2["color"].cat.reorder_categories(
+        ["green", "blue", "red"])
+    np.testing.assert_allclose(bst.predict(df2), p1, rtol=1e-12)
+
+
+def test_sklearn_wrapper_accepts_dataframe():
+    from lightgbm_tpu.sklearn import LGBMClassifier
+    df, y = _frame()
+    est = LGBMClassifier(n_estimators=10, num_leaves=15, verbose=-1)
+    est.fit(df, y)
+    proba = est.predict_proba(df)
+    assert proba.shape == (len(df), 2)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, proba[:, 1]) > 0.7
