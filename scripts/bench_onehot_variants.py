@@ -38,7 +38,9 @@ def emit(**kv):
 
 
 def make_kernel(f, Bp, BR, onehot_fn):
-    """Row-major single-block kernel with a pluggable one-hot builder."""
+    """Feature-major single-block kernel (bins pre-transposed OUTSIDE —
+    the production layout; the in-kernel transpose benched 35x slower) with
+    a pluggable one-hot builder."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -48,24 +50,24 @@ def make_kernel(f, Bp, BR, onehot_fn):
         def _init():
             out_ref[:] = jnp.zeros_like(out_ref)
 
-        b = bins_ref[:].T[:f]                                 # [f, BR] u8
+        b = bins_ref[:]                                       # [f, BR] u8
         onehot = onehot_fn(b, f, Bp, BR).reshape(f * Bp, BR)
         out_ref[:] += jax.lax.dot_general(
             gh_ref[:], onehot,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    def run(bins, gh6):
-        n = bins.shape[0]
+    def run(bins_t, gh6):
+        n = bins_t.shape[1]
         assert n % BR == 0
         return pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct((6, f * Bp), jnp.float32),
             grid=(n // BR,),
-            in_specs=[pl.BlockSpec((BR, bins.shape[1]), lambda i: (i, 0)),
+            in_specs=[pl.BlockSpec((f, BR), lambda i: (0, i)),
                       pl.BlockSpec((6, BR), lambda i: (0, i))],
             out_specs=pl.BlockSpec((6, f * Bp), lambda i: (0, 0)),
-        )(bins, gh6)
+        )(bins_t, gh6)
     return run
 
 
@@ -122,10 +124,10 @@ def main():
     g = jnp.asarray(rng.normal(size=N).astype(np.float32))
     h = jnp.asarray(np.full(N, 0.25, np.float32))
     m = jnp.ones(N, jnp.float32)
-    gh = jnp.stack([g * m, h * m, m], axis=0)
-    hi = gh.astype(jnp.bfloat16)
-    lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    gh6 = jnp.concatenate([hi, lo], axis=0)
+    from lightgbm_tpu.ops.histogram import _gh6
+    gh6 = _gh6(g, h, m)                     # fenced split-precision pair
+    bins_t = jnp.asarray(np.ascontiguousarray(
+        np.asarray(bins).T))                # [F, N] u8, transposed ONCE
 
     ref = jax.jit(lambda b_, g_: _hist_onehot(b_, g_, h, m, B, 65536))(bins, g)
     ref = ref.block_until_ready()
@@ -143,16 +145,17 @@ def main():
         try:
             run = make_kernel(F, Bp, BR, fn)
             jfn = jax.jit(run)
-            out = jfn(bins, gh6).block_until_ready()
+            out = jfn(bins_t, gh6).block_until_ready()
             hist = (out.reshape(2, 3, F, Bp)[0]
                     + out.reshape(2, 3, F, Bp)[1])[:, :, :B].transpose(1, 2, 0)
+            # same tolerance derivation as scripts/bench_dual.py TOL
             err = float(jnp.max(jnp.abs(hist - ref) / (jnp.abs(ref) + 1.0)))
-            if err > 1e-4:
+            if err > 5e-4:
                 emit(stage="onehot_variant", name=name, ok=False, relerr=err)
                 continue
             t0 = time.perf_counter()
             for _ in range(10):
-                r = jfn(bins, gh6)
+                r = jfn(bins_t, gh6)
             r.block_until_ready()
             dt = (time.perf_counter() - t0) / 10
             emit(stage="onehot_variant", name=name, ok=True,
