@@ -215,7 +215,8 @@ class Dataset:
     def set_feature_name(self, feature_name) -> "Dataset":
         self.feature_name = feature_name
         if self._inner is not None:
-            names = list(feature_name)
+            from .io.dataset import _sanitize_feature_names
+            names = _sanitize_feature_names(list(feature_name))
             check(len(names) == self._inner.num_total_features,
                   "Length of feature names doesn't equal with num_feature")
             self._inner.feature_names = names

@@ -150,7 +150,8 @@ class Dataset:
         else:
             data = _to_2d_float(data)
         self.num_data, self.num_total_features = data.shape
-        self.feature_names = list(feature_names) if feature_names else [
+        self.feature_names = _sanitize_feature_names(
+            list(feature_names)) if feature_names else [
             f"Column_{i}" for i in range(self.num_total_features)]
 
         if reference is not None:
@@ -596,6 +597,36 @@ def _to_2d_float(data) -> np.ndarray:
         arr = arr.reshape(-1, 1)
     check(arr.ndim == 2, "data must be 2-dimensional")
     return np.ascontiguousarray(arr, dtype=np.float64)
+
+
+def _sanitize_feature_names(names: "List[str]") -> "List[str]":
+    """Reference ``Dataset::set_feature_names`` (``dataset.h:605-625``):
+    whitespace becomes underscores (with a warning — the model text stores
+    names space-separated, so whitespace would corrupt the list on reload),
+    special JSON characters are rejected (the exact
+    ``Common::CheckAllowedJSON`` set, ``utils/common.h:844``), duplicates
+    are rejected."""
+    out = []
+    had_space = False
+    for name in names:
+        name = str(name)
+        if any(c in name for c in '",:[]{}'):
+            raise ValueError(
+                f"Do not support special JSON characters in feature name "
+                f"({name!r})")
+        if any(c.isspace() for c in name):
+            # the reference replaces ' ' only, but our loader splits the
+            # feature_names= line on ANY whitespace — neutralize all of it
+            had_space = True
+            name = "".join("_" if c.isspace() else c for c in name)
+        out.append(name)
+    if had_space:
+        Log.warning("Found whitespace in feature_names, replaced with "
+                    "underscores")
+    if len(set(out)) != len(out):
+        dup = next(n for n in out if out.count(n) > 1)
+        raise ValueError(f"Feature ({dup}) appears more than one time.")
+    return out
 
 
 def _is_dataframe(data) -> bool:

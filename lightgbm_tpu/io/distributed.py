@@ -87,7 +87,9 @@ def distributed_dataset(data, config: Optional[Config] = None, label=None,
     n_local, n_feat = data.shape
     self.num_data = n_local
     self.num_total_features = n_feat
-    self.feature_names = list(feature_names) if feature_names else [
+    from .dataset import _sanitize_feature_names
+    self.feature_names = _sanitize_feature_names(
+        list(feature_names)) if feature_names else [
         f"Column_{i}" for i in range(n_feat)]
 
     # --- shard agreement: every process must bring the same feature count

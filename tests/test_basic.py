@@ -94,3 +94,40 @@ def test_subset(binary_data):
     sub = ds.subset(np.arange(100)).construct()
     assert sub.num_data() == 100
     np.testing.assert_array_equal(sub._inner.bins, ds._inner.bins[:100])
+
+
+def test_feature_name_space_sanitized():
+    """Reference Dataset::set_feature_names (dataset.h:605-625): spaces in
+    names become underscores (the model text stores names space-separated),
+    JSON-special characters and duplicates are rejected."""
+    import lightgbm_tpu as lgb
+    X = np.random.default_rng(0).normal(size=(200, 3))
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 7},
+                    lgb.Dataset(X, label=y,
+                                feature_name=["a b", "温度", "c"]), 3)
+    assert bst.feature_name() == ["a_b", "温度", "c"]
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "m.txt")
+        bst.save_model(path)
+        assert lgb.Booster(model_file=path).feature_name() == \
+            ["a_b", "温度", "c"]
+
+    # exact reference CheckAllowedJSON set (utils/common.h:844): these are
+    # rejected...
+    for bad in ['a"b', "a,b", "a:b", "a[b", "a]b", "a{b", "a}b"]:
+        with pytest.raises(ValueError, match="special JSON"):
+            lgb.Dataset(X, label=y, feature_name=[bad, "x", "y"]).construct()
+    # ...while '/' and backslash are allowed, like the reference
+    ok = lgb.Dataset(X, label=y, feature_name=["km/h", "a\\b", "y"])
+    assert ok.construct()._inner.feature_names == ["km/h", "a\\b", "y"]
+    # ALL whitespace is neutralized (our loader splits on any whitespace),
+    # which makes the tab and vertical-tab names collide -> duplicate error
+    with pytest.raises(ValueError, match="more than one time"):
+        lgb.Dataset(X, label=y,
+                    feature_name=["a\tb", "a\x0bb", "y"]).construct()
+    tab = lgb.Dataset(X, label=y, feature_name=["a\tb", "c\x0bd", "y"])
+    assert tab.construct()._inner.feature_names == ["a_b", "c_d", "y"]
+    with pytest.raises(ValueError, match="more than one time"):
+        lgb.Dataset(X, label=y, feature_name=["x", "x", "y"]).construct()
