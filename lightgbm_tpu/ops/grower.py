@@ -242,8 +242,9 @@ def _frontier_eligible(cfg: "GrowerConfig", n_cols: int, interaction_sets,
           and mode in (None, "data", "feature", "voting")
           and (efb is None or mode in (None, "data")))
     if ok and cfg.hist_method == "pallas":
-        # the batched kernel only has the row-major layout; very wide
-        # feature blocks exceed its lane budget
+        # the batched-leaf kernel's bins block spans all features at once
+        # (single feature block); very wide feature sets exceed its lane
+        # budget
         from .histogram import _PALLAS_ROWMAJOR_MAX_LANES
         bb = cfg.bundle_bins or cfg.max_bin
         ok = n_cols * (-(-bb // 128) * 128) <= _PALLAS_ROWMAJOR_MAX_LANES

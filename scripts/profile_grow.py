@@ -35,11 +35,16 @@ sp = SplitParams(lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=100,
                  cat_l2=10.0, max_cat_to_onehot=4)
 import os
 cfg = GrowerConfig(num_leaves=leaves, max_depth=-1, max_bin=B, split=sp,
-                   feature_fraction_bynode=1.0, hist_method="pallas",
+                   feature_fraction_bynode=1.0,
+                   hist_method=("pallas" if jax.default_backend() == "tpu"
+                                else "scatter"),
                    hist_chunk_rows=chunk, hist_compact=compact,
                    sorted_cat=bool(int(os.environ.get("PROF_SORTED_CAT", "0"))),
                    hist_compact_ladder=float(os.environ.get("PROF_LADDER",
-                                                            "1.41")))
+                                                            "1.41")),
+                   grower_mode=os.environ.get("PROF_GROWER", "serial"),
+                   frontier_k=int(os.environ.get("PROF_K", "32")),
+                   frontier_block_rows=int(os.environ.get("PROF_BR", "512")))
 
 
 @jax.jit
@@ -59,3 +64,13 @@ for trial in range(3):
     float(s)
     dt = time.perf_counter() - t0
     print(f"grow: {dt*1e3:.0f} ms  ({dt/max(int(nl)-1,1)*1e3:.2f} ms/split, {int(nl)} leaves)")
+
+# optional: one profiled iteration (PROF_TRACE=/tmp/trace writes a
+# jax.profiler trace attributing per-round cost: gather vs kernel vs
+# cumsum/partition vs split search)
+trace_dir = os.environ.get("PROF_TRACE")
+if trace_dir:
+    with jax.profiler.trace(trace_dir):
+        nl, s = run(bins, g, h, rw, fm, jax.random.PRNGKey(9))
+        float(s)
+    print(f"trace written to {trace_dir}")
