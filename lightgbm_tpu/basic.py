@@ -302,7 +302,6 @@ class Booster:
         self.train_set = train_set
         self.best_iteration = -1
         self.best_score: Dict[str, Dict[str, float]] = {}
-        self._network_initialized = False
         self.pandas_categorical = None
         if train_set is not None:
             check(isinstance(train_set, Dataset), "training data should be Dataset instance")
@@ -508,8 +507,37 @@ class Booster:
         self.best_iteration = state["best_iteration"]
         self.best_score = state["best_score"]
         self.train_set = None
-        self._network_initialized = False
         self._load_from_string(state["model_str"])
+
+    def set_network(self, machines, local_listen_port: int = 12400,
+                    listen_time_out: int = 120,
+                    num_machines: Optional[int] = None) -> "Booster":
+        """Set up multi-process training from a machine list (reference
+        ``Booster.set_network``, ``basic.py:2206``) — delegates to
+        ``parallel.mesh.set_network`` (jax.distributed bring-up);
+        ``num_machines`` defaults to the machine-list length."""
+        from .parallel.mesh import set_network as _set_network
+        _set_network(machines, local_listen_port=local_listen_port,
+                     listen_time_out=listen_time_out,
+                     num_machines=num_machines)
+        return self
+
+    def free_network(self) -> "Booster":
+        """Tear the process group down (reference ``Booster.free_network``)."""
+        from .parallel.mesh import free_network as _free_network
+        _free_network()
+        return self
+
+    def free_dataset(self) -> "Booster":
+        """Drop the python-side training/validation Dataset references so
+        their raw arrays can be reclaimed (reference
+        ``Booster.free_dataset``).  The engine keeps its binned copy, so
+        further ``update()``/eval/predict continue to work — but callbacks
+        that receive the python ``Dataset`` (custom ``fobj``/``feval``)
+        will see ``None`` afterwards."""
+        self.train_set = None
+        self.valid_sets_py = []
+        return self
 
     def current_iteration(self) -> int:
         """Number of completed iterations (reference

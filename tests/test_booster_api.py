@@ -177,3 +177,17 @@ def test_feature_contri_exact_length_required(binary_data):
         lgb.train({"objective": "binary", "verbose": -1,
                    "feature_contri": [1.0] * (n_feat + 3)},
                   lgb.Dataset(Xtr, label=ytr), num_boost_round=1)
+
+
+def test_booster_network_and_free_dataset_methods(binary_data):
+    """Booster.set_network/free_network/free_dataset exist as methods like
+    the reference (basic.py:2206); free_dataset drops the training data
+    but keeps prediction working."""
+    X, y = binary_data[0], binary_data[1]
+    bst = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 7},
+                    lgb.Dataset(X, label=y), 3)
+    p = bst.predict(X)
+    assert callable(bst.set_network) and callable(bst.free_network)
+    bst.free_dataset()
+    assert bst.train_set is None
+    assert np.allclose(bst.predict(X), p)
