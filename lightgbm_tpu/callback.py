@@ -73,6 +73,14 @@ def reset_parameter(**kwargs) -> Callable:
                 new_params[key] = value[env.iteration - env.begin_iteration]
             elif callable(value):
                 new_params[key] = value(env.iteration - env.begin_iteration)
+            else:
+                # reference callback.reset_parameter: anything else is a
+                # user error, not a silent no-op
+                raise ValueError(
+                    "Only list and callable values are supported "
+                    f"as a mapping from boosting round index to new "
+                    f"parameter value (got {type(value).__name__} for "
+                    f"{key!r}).")
         if new_params:
             # route through Booster.reset_parameter so compile-time grower
             # params (num_leaves, min_data_in_leaf, ...) genuinely re-apply

@@ -15,16 +15,34 @@ from .utils.log import Log, LightGBMError
 __all__ = ["train", "cv", "CVBooster"]
 
 
+def _apply_dataset_kwargs(train_set: Dataset, feature_name,
+                          categorical_feature) -> None:
+    """Shared by train()/cv(): the reference applies these kwargs to the
+    training Dataset before construction (``engine.py:96-99``)."""
+    if feature_name != "auto":
+        train_set.set_feature_name(feature_name)
+    if categorical_feature != "auto":
+        train_set.set_categorical_feature(categorical_feature)
+
+
 def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
           valid_sets: Optional[List[Dataset]] = None,
           valid_names: Optional[List[str]] = None,
           fobj: Optional[Callable] = None, feval: Optional[Callable] = None,
-          init_model: Optional[str] = None, keep_training_booster: bool = False,
-          callbacks: Optional[List[Callable]] = None,
+          init_model: Optional[str] = None,
+          feature_name: Any = "auto", categorical_feature: Any = "auto",
           early_stopping_rounds: Optional[int] = None,
-          verbose_eval: Any = True, evals_result: Optional[Dict] = None) -> Booster:
-    """Train a booster (reference ``engine.py:15``; loop at ``:230-270``)."""
+          evals_result: Optional[Dict] = None,
+          verbose_eval: Any = True, learning_rates: Any = None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    """Train a booster (reference ``engine.py:15``; loop at ``:230-270``).
+
+    The positional parameter order is the REFERENCE's exactly, so
+    positionally-called reference code binds every argument the same way.
+    """
     params = dict(params or {})
+    _apply_dataset_kwargs(train_set, feature_name, categorical_feature)
     # resolve aliases that control the loop itself
     for alias in ("num_iterations", "num_iteration", "n_iter", "num_tree", "num_trees",
                   "num_round", "num_rounds", "num_boost_round", "n_estimators"):
@@ -62,6 +80,9 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         booster._gbdt.continue_from(prev._gbdt)
 
     cbs = list(callbacks or [])
+    if learning_rates is not None:
+        # reference engine.py: list or callable(iter) -> reset_parameter
+        cbs.append(callback_mod.reset_parameter(learning_rate=learning_rates))
     if verbose_eval is True:
         cbs.append(callback_mod.print_evaluation())
     elif isinstance(verbose_eval, int) and verbose_eval > 0:
@@ -180,11 +201,7 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     if params.get("objective") in (None, "regression") and stratified:
         stratified = False
 
-    if feature_name != "auto":
-        train_set.set_feature_name(feature_name)
-    if categorical_feature != "auto":
-        # construct-aware: resets a built Dataset for re-binning
-        train_set.set_categorical_feature(categorical_feature)
+    _apply_dataset_kwargs(train_set, feature_name, categorical_feature)
     train_set.construct()
     results: Dict[str, List[float]] = {}
     cvbooster = CVBooster()

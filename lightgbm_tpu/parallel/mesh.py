@@ -95,14 +95,32 @@ def set_network(machines, local_listen_port: int = 12400,
         local_names.add(socket.gethostbyname(socket.gethostname()))
     except OSError:
         pass
-    matches = []
-    for i, h in enumerate(hosts):
+
+    def _is_local_addr(addr: str) -> bool:
+        """A bind() to addr succeeds exactly when addr belongs to a local
+        interface — robust where hostname mapping is not (e.g. Debian's
+        127.0.1.1 /etc/hosts entry hides the real NIC address)."""
         try:
-            addr = socket.gethostbyname(h)
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.bind((addr, 0))
+            return True
         except OSError:
-            addr = h
-        if h in local_names or addr in local_names:
-            matches.append(i)
+            return False
+
+    addrs = []
+    for h in hosts:
+        try:
+            addrs.append(socket.gethostbyname(h))
+        except OSError:
+            addrs.append(h)
+    matches = [i for i, (h, a) in enumerate(zip(hosts, addrs))
+               if h in local_names or a in local_names]
+    if not matches:
+        # fallback for hosts whose hostname does not map to the NIC
+        # address (Debian's 127.0.1.1 /etc/hosts entry): bind-probe each
+        # entry.  Only as a fallback — the whole 127/8 block is bindable,
+        # so loopback multi-entry lists must resolve by name above.
+        matches = [i for i, a in enumerate(addrs) if _is_local_addr(a)]
     if len(matches) > 1:
         # same host listed multiple times (multi-process-per-box layout):
         # hostname matching cannot tell the processes apart

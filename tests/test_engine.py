@@ -1027,3 +1027,29 @@ def test_extra_seed_changes_extra_trees(binary_data):
     a, b, c = tr(1), tr(2), tr(1)
     assert a.model_to_string() == c.model_to_string()
     assert a.model_to_string() != b.model_to_string()
+
+
+def test_train_learning_rates_and_feature_kwargs(binary_data):
+    """train() accepts learning_rates (list or callable) and
+    feature_name/categorical_feature kwargs like the reference engine."""
+    X, y = binary_data[0], binary_data[1]
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 7}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 6,
+                    feature_name=[f"n{i}" for i in range(X.shape[1])],
+                    learning_rates=lambda it: 0.1 * (0.9 ** it))
+    assert bst.feature_name() == [f"n{i}" for i in range(X.shape[1])]
+    # decayed learning rates change later trees vs a constant-lr run
+    ref = lgb.train(params, lgb.Dataset(X, label=y), 6)
+    assert not np.allclose(bst.predict(X), ref.predict(X))
+    bst2 = lgb.train(params, lgb.Dataset(X, label=y), 3,
+                     learning_rates=[0.1, 0.05, 0.025])
+    assert bst2.num_trees() == 3
+
+
+def test_reset_parameter_scalar_raises(binary_data):
+    """Scalar learning_rates is a user error, not a silent no-op
+    (reference callback.reset_parameter)."""
+    X, y = binary_data[0], binary_data[1]
+    with pytest.raises(ValueError, match="list and callable"):
+        lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 7},
+                  lgb.Dataset(X, label=y), 3, learning_rates=0.05)
