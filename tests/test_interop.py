@@ -160,3 +160,36 @@ def test_same_data_accuracy_parity(tmp_path, data):
     # (docs/GPU-Performance.rst:131-161) plus AUC noise at 3000 test rows
     assert abs(our_auc - ref_auc) < 5e-3, (our_auc, ref_auc)
     assert our_auc > 0.75 and ref_auc > 0.75
+
+
+def test_pandas_categorical_model_through_reference_binary(tmp_path):
+    """A model trained on a pandas DataFrame (category dtypes, trailing
+    pandas_categorical line in the file) must still load in the reference
+    binary, and its predictions on the CODES matrix must match ours on the
+    frame — proving the pandas path keeps file-format interop."""
+    pd = pytest.importorskip("pandas")
+    rng = np.random.default_rng(5)
+    n = 2000
+    df = pd.DataFrame({
+        "num0": rng.normal(size=n),
+        "color": pd.Categorical(rng.choice(["r", "g", "b"], n)),
+        "num1": rng.normal(size=n)})
+    y = ((df["color"] == "g") | (df["num0"] > 0.8)).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "min_data_in_leaf": 20}
+    bst = lgb.train(p, lgb.Dataset(df, label=y, params=p), 10)
+    model = tmp_path / "ours.txt"
+    bst.save_model(str(model))
+    assert "pandas_categorical:" in model.read_text()
+
+    codes = np.column_stack([
+        df["num0"].to_numpy(),
+        df["color"].cat.codes.to_numpy().astype(np.float64),
+        df["num1"].to_numpy()])
+    _write_csv(tmp_path / "test.csv", codes[:400], y[:400])
+    _ref_cli(str(tmp_path), task="predict", data="test.csv",
+             input_model="ours.txt", output_result="preds.txt",
+             header="false")
+    ref_preds = np.loadtxt(tmp_path / "preds.txt")
+    np.testing.assert_allclose(ref_preds, bst.predict(df.head(400)),
+                               rtol=1e-5, atol=1e-6)
