@@ -26,9 +26,14 @@ class Dataset:
 
     def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
                  weight=None, group=None, init_score=None,
+                 silent: bool = False,
                  feature_name: Union[str, List[str]] = "auto",
                  categorical_feature: Union[str, List[int], List[str]] = "auto",
                  params: Optional[Dict[str, Any]] = None, free_raw_data: bool = True):
+        # ``silent`` sits at the reference's position (basic.py:938) and,
+        # like the reference, injects verbose=-1 unless the user set a
+        # verbosity themselves
+        self.silent = silent
         self.data = data
         self.label = label
         self.reference = reference
@@ -50,6 +55,9 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._inner is not None:
             return self
+        if self.silent and not any(a in self.params for a in (
+                "verbose", "verbosity")):
+            self.params["verbose"] = -1
         cfg = Config.from_params(self.params)
         data = self.data
         if isinstance(data, str):
@@ -176,9 +184,10 @@ class Dataset:
 
     # ------------------------------------------------------------------
     def create_valid(self, data, label=None, weight=None, group=None,
-                     init_score=None, params=None) -> "Dataset":
+                     init_score=None, silent: bool = False,
+                     params=None) -> "Dataset":
         return Dataset(data, label=label, reference=self, weight=weight,
-                       group=group, init_score=init_score,
+                       group=group, init_score=init_score, silent=silent,
                        params=params or self.params)
 
     def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
@@ -297,8 +306,13 @@ class Booster:
     def __init__(self, params: Optional[Dict[str, Any]] = None,
                  train_set: Optional[Dataset] = None,
                  model_file: Optional[str] = None,
-                 model_str: Optional[str] = None):
+                 model_str: Optional[str] = None,
+                 silent: bool = False):
         self.params = dict(params or {})
+        self.silent = silent
+        if silent and not any(a in self.params for a in
+                              ("verbose", "verbosity")):
+            self.params["verbose"] = -1     # reference Booster(silent=True)
         self.train_set = train_set
         self.best_iteration = -1
         self.best_score: Dict[str, Dict[str, float]] = {}

@@ -395,6 +395,14 @@ class Config:
     def finalize(self) -> None:
         """Normalize enums + run conflict checks (reference
         ``Config::Set``/``CheckParamConflict``, ``src/io/config.cpp:194,255``)."""
+        # verbosity drives the global logger exactly like the reference's
+        # per-entry ResetLogLevel (c_api: <0 Fatal-only, 0 Warning,
+        # 1 Info, >1 Debug)
+        from .utils.log import LogLevel, reset_log_level
+        reset_log_level(LogLevel.FATAL if self.verbosity < 0
+                        else LogLevel.WARNING if self.verbosity == 0
+                        else LogLevel.INFO if self.verbosity == 1
+                        else LogLevel.DEBUG)
         self.objective = _OBJECTIVE_ALIASES.get(self.objective.lower(), self.objective.lower())
         self.boosting = {"gbrt": "gbdt", "random_forest": "rf"}.get(self.boosting.lower(), self.boosting.lower())
         self.tree_learner = {"serial_tree_learner": "serial", "feature_parallel": "feature",

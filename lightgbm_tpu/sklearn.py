@@ -86,7 +86,12 @@ class LGBMModel(_SKLBase):
                  subsample_freq: int = 0, colsample_bytree: float = 1.0,
                  reg_alpha: float = 0.0, reg_lambda: float = 0.0,
                  random_state: Optional[int] = None, n_jobs: int = -1,
+                 silent: bool = True,
                  importance_type: str = "split", **kwargs):
+        # ``silent`` sits at the reference's position (sklearn.py:180) so
+        # positional callers bind identically; it is estimator state, not a
+        # booster param
+        self.silent = silent
         self.boosting_type = boosting_type
         self.num_leaves = num_leaves
         self.max_depth = max_depth
@@ -121,7 +126,8 @@ class LGBMModel(_SKLBase):
             "n_estimators", "subsample_for_bin", "objective", "class_weight",
             "min_split_gain", "min_child_weight", "min_child_samples",
             "subsample", "subsample_freq", "colsample_bytree", "reg_alpha",
-            "reg_lambda", "random_state", "n_jobs", "importance_type")}
+            "reg_lambda", "random_state", "n_jobs", "silent",
+            "importance_type")}
         params.update(self._other_params)
         return params
 
@@ -148,7 +154,9 @@ class LGBMModel(_SKLBase):
             "feature_fraction": self.colsample_bytree,
             "lambda_l1": self.reg_alpha,
             "lambda_l2": self.reg_lambda,
-            "verbose": -1,
+            # reference sklearn wrapper: silent picks the verbosity (an
+            # explicit verbose/verbosity kwarg in _other_params overrides)
+            "verbose": -1 if self.silent else 1,
         }
         if self.random_state is not None:
             p["seed"] = int(self.random_state)

@@ -191,3 +191,46 @@ def test_booster_network_and_free_dataset_methods(binary_data):
     bst.free_dataset()
     assert bst.train_set is None
     assert np.allclose(bst.predict(X), p)
+
+
+def test_silent_positional_parity(binary_data):
+    """Dataset/Booster/LGBMModel carry `silent` at the reference's exact
+    positional slot, so reference-style positional calls bind correctly."""
+    X, y = binary_data[0], binary_data[1]
+    # reference positional shape: (data, label, reference, weight, group,
+    # init_score, silent, feature_name, categorical_feature, params)
+    names = [f"c{i}" for i in range(X.shape[1])]
+    ds = lgb.Dataset(X, y, None, None, None, None, True, names)
+    assert ds.silent is True and ds.feature_name == names
+    bst = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 7},
+                    ds, 3)
+    assert bst.feature_name() == names
+    # Booster(params, train_set, model_file, model_str, silent)
+    s = bst.model_to_string()
+    b2 = lgb.Booster(None, None, None, s, True)
+    assert b2.silent is True
+    assert np.allclose(b2.predict(X), bst.predict(X))
+    from lightgbm_tpu.sklearn import LGBMClassifier
+    from sklearn.base import clone
+    est = LGBMClassifier(silent=False)
+    assert clone(est).get_params()["silent"] is False
+
+
+def test_verbosity_drives_logger_and_silent_injects_it(binary_data, capsys):
+    """verbosity maps to the global log level like the reference's
+    per-entry ResetLogLevel; silent=True injects verbose=-1."""
+    from lightgbm_tpu.utils.log import get_log_level, LogLevel
+    X, y = binary_data[0], binary_data[1]
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": 1},
+              lgb.Dataset(X, label=y), 1)
+    assert get_log_level() == LogLevel.INFO
+    assert "[Info]" in capsys.readouterr().out
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+              lgb.Dataset(X, label=y), 1)
+    assert get_log_level() == LogLevel.FATAL
+    assert "[Info]" not in capsys.readouterr().out
+    ds = lgb.Dataset(X, label=y, silent=True)
+    ds.construct()
+    assert ds.params["verbose"] == -1
+    # restore chatty default for other tests
+    lgb.Dataset(X, label=y, params={"verbose": 1}).construct()
