@@ -174,6 +174,8 @@ class BinMapper:
         n_avail = max_bin
         if use_missing and self.missing_type == MissingType.NAN:
             n_avail -= 1  # reserve trailing NaN bin
+        if zero_as_missing:
+            n_avail = max(2, n_avail - 2)   # reserve the +-eps boundaries
 
         if forced_upper_bounds:
             bounds = sorted(set(float(b) for b in forced_upper_bounds))
@@ -184,7 +186,16 @@ class BinMapper:
         else:
             ub = _greedy_find_bin(uniq, counts, n_avail, total_cnt, min_data_in_bin)
 
-        # guarantee a pure zero bin boundary so default_bin is well-defined
+        if zero_as_missing:
+            # reference FindBinWithZeroAsOneBin (bin.cpp): the zero bin is
+            # EXACTLY (-kZeroThreshold, +kZeroThreshold] — force both
+            # boundaries and drop any greedy boundary inside, so no real
+            # value can share the bin that training and prediction route by
+            # the split's default direction.  (A merged bin silently sent
+            # its real-valued rows down the missing path: round-4 fix.)
+            K = K_ZERO_THRESHOLD
+            ub = [b for b in ub if not (-K < b < K)]
+            ub = sorted(set(ub + [-K, K]))
         self.bin_upper_bound = np.asarray(ub, dtype=np.float64)
         self.num_bin = len(ub)
         if use_missing and self.missing_type == MissingType.NAN:

@@ -80,7 +80,8 @@ class DeviceData:
     num_bins: Any        # [num_features] int32 — bins per feature
     bin_offsets: Any     # [num_features+1] int32 — flattened histogram offsets
     default_bins: Any    # [num_features] int32 — bin containing raw value 0
-    nan_bins: Any        # [num_features] int32 — NaN bin (== num_bin-1) or -1
+    nan_bins: Any        # [num_features] i32 — MISSING bin: trailing NaN
+    #                      bin (NAN type), zero bin (ZERO type), or -1
     is_categorical: Any  # [num_features] bool
     monotone: Any        # [num_features] int8 (-1/0/+1)
     total_bins: int
@@ -466,11 +467,25 @@ class Dataset:
         nb = np.array([self.bin_mappers[f].num_bin for f in feats], dtype=np.int32)
         offsets = np.concatenate([[0], np.cumsum(nb)]).astype(np.int32)
         default_bins = np.array([self.bin_mappers[f].default_bin for f in feats], dtype=np.int32)
-        nan_bins = np.array(
-            [self.bin_mappers[f].num_bin - 1
-             if self.bin_mappers[f].missing_type == MissingType.NAN
-             and self.bin_mappers[f].bin_type == BinType.NUMERICAL else -1
-             for f in feats], dtype=np.int32)
+        # per-feature MISSING bin (or -1): the trailing NaN bin for
+        # NaN-missing features, and the ZERO bin (default_bin) for
+        # zero_as_missing features — the grower's partition, the binned
+        # traversal and the split search all route this bin by the split's
+        # default direction, exactly like raw-value prediction routes
+        # |x| <= kZeroThreshold (reference Tree::NumericalDecision); leaving
+        # ZERO features at -1 made training sweep the zero bin by threshold
+        # order while predict sent zeros the default way — silently wrong
+        # predictions on every zero row (round-4 fix, test_basic.py)
+        def _miss_bin(m):
+            if m.bin_type != BinType.NUMERICAL:
+                return -1
+            if m.missing_type == MissingType.NAN:
+                return m.num_bin - 1
+            if m.missing_type == MissingType.ZERO:
+                return m.default_bin
+            return -1
+        nan_bins = np.array([_miss_bin(self.bin_mappers[f]) for f in feats],
+                            dtype=np.int32)
         is_cat = np.array([self.bin_mappers[f].bin_type == BinType.CATEGORICAL
                            for f in feats], dtype=bool)
         mono = np.zeros(len(feats), dtype=np.int8)

@@ -592,7 +592,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         k = jax.random.fold_in(
             jax.random.fold_in(jax.random.fold_in(key, 7919), step),
             cfg.extra_seed)
-        hi = jnp.maximum(num_bins_l - 2 - (nan_bins_l >= 0), 0)
+        # a TRAILING missing bin removes the last real threshold; a
+        # mid-range missing bin (zero_as_missing) keeps the full range
+        # (matches split.py's valid_t)
+        hi = jnp.maximum(
+            num_bins_l - 2 - (nan_bins_l == num_bins_l - 1), 0)
         u = jax.random.uniform(k, (num_bins_l.shape[0],))
         return jnp.floor(u * (hi + 1).astype(jnp.float32)).astype(jnp.int32)
 

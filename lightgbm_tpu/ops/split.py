@@ -114,9 +114,10 @@ def _split_gain_matrix(hist, num_bins, nan_bins, is_categorical, monotone,
     bin_ids = jnp.arange(b, dtype=jnp.int32)[None, :]                  # [1, B]
 
     # --- extract "missing" bin per feature, zero it out of the sweep ---
-    # NaN-bin features: missing = trailing NaN bin; zero-as-missing features
-    # have nan_bins == -1 and their default (zero) bin is swept normally
-    # (missing direction then only matters for true NaN bins).
+    # NaN-missing features: the trailing NaN bin; zero-as-missing features:
+    # the zero bin (mid-range in general).  Either way the bin is excluded
+    # from the ordered sweep and trialed on both sides (the reference's
+    # REVERSE/NA_AS_MISSING + SKIP_DEFAULT_BIN cases in one formulation).
     miss_bin = nan_bins                                                # [F]
     has_miss = miss_bin >= 0
     miss_sel = (bin_ids == miss_bin[:, None]) & has_miss[:, None]      # [F, B]
@@ -125,8 +126,11 @@ def _split_gain_matrix(hist, num_bins, nan_bins, is_categorical, monotone,
 
     cum = jnp.cumsum(swept, axis=1)                                    # [F, B, 3]
 
-    # threshold t means: bins <= t go left (t in [0, num_bin-2])
-    valid_t = bin_ids < (num_bins[:, None] - 1 - (has_miss[:, None]))  # [F, B]
+    # threshold t means: bins <= t go left (t in [0, num_bin-2]); when the
+    # missing bin is the TRAILING bin the last real threshold drops with it,
+    # but a mid-range missing bin (zero_as_missing) keeps the full range
+    trailing_miss = has_miss & (miss_bin == num_bins - 1)
+    valid_t = bin_ids < (num_bins[:, None] - 1 - trailing_miss[:, None])
 
     def eval_direction(missing_left):
         left = cum + jnp.where(missing_left, miss[:, None, :], 0.0)    # [F, B, 3]

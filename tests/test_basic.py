@@ -131,3 +131,66 @@ def test_feature_name_space_sanitized():
     assert tab.construct()._inner.feature_names == ["a_b", "c_d", "y"]
     with pytest.raises(ValueError, match="more than one time"):
         lgb.Dataset(X, label=y, feature_name=["x", "x", "y"]).construct()
+
+
+def test_small_max_bin_trains():
+    """max_bin down to 2 must bin and train cleanly (reference
+    test_small_max_bin)."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1000, 4))
+    y = (X[:, 0] > 0).astype(float)
+    for mb in (2, 3, 4):
+        p = {"objective": "binary", "verbose": -1, "max_bin": mb,
+             "num_leaves": 7, "min_data_in_leaf": 5}
+        bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 5)
+        assert bst.num_trees() == 5
+        assert np.corrcoef(bst.predict(X), y)[0, 1] > 0.5
+
+
+def test_same_sign_binning_with_zero_as_missing():
+    """All-positive features with zero_as_missing (reference
+    test_binning_same_sign): the zero-carrying column binds with
+    MissingType.ZERO and its zero pattern is learnable."""
+    rng = np.random.default_rng(1)
+    X = np.abs(rng.normal(size=(1000, 3))) + 0.1
+    zero_rows = rng.uniform(size=1000) < 0.3
+    X[zero_rows, 1] = 0.0
+    # the label DEPENDS on the zero pattern, so ignoring the missing path
+    # would visibly hurt separation
+    y = (zero_rows | (X[:, 0] > 1.2)).astype(float)
+    p = {"objective": "binary", "verbose": -1, "zero_as_missing": True,
+         "num_leaves": 7, "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, 5)
+    assert ds.construct()._inner.bin_mappers[1].missing_type == \
+        MissingType.ZERO
+    assert np.corrcoef(bst.predict(X), y)[0, 1] > 0.9
+
+
+def test_zero_as_missing_pure_zero_bin_and_raw_parity():
+    """The zero bin must be EXACTLY (-eps, +eps] (reference
+    FindBinWithZeroAsOneBin): small nonzero values may not share the bin
+    that is routed by the default direction, and raw-value predict must
+    agree with the internal binned traversal everywhere."""
+    rng = np.random.default_rng(3)
+    n = 1200
+    X = np.empty((n, 2))
+    # column 0: a spike of small positives right next to zero + zeros
+    X[:, 0] = np.where(rng.uniform(size=n) < 0.3, 0.0,
+                       np.where(rng.uniform(size=n) < 0.5, 0.01,
+                                rng.uniform(1.0, 3.0, size=n)))
+    X[:, 1] = rng.normal(size=n)
+    y = ((X[:, 0] == 0.0) | (X[:, 1] > 0.8)).astype(float)
+    p = {"objective": "binary", "verbose": -1, "zero_as_missing": True,
+         "num_leaves": 7, "min_data_in_leaf": 5, "min_data_in_bin": 3}
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.Booster(params=p, train_set=ds)
+    for _ in range(10):
+        bst.update()
+    m = ds.construct()._inner.bin_mappers[0]
+    zb = m.value_to_bin(np.array([0.0]))[0]
+    assert m.value_to_bin(np.array([0.01]))[0] != zb
+    # raw predict == internal binned score on every row (incl. the 0.01s)
+    internal = np.asarray(bst._gbdt._train_score[0])
+    np.testing.assert_allclose(bst.predict(X, raw_score=True), internal,
+                               rtol=1e-5, atol=1e-5)
