@@ -97,6 +97,18 @@ class Dataset:
                 self.reference.construct()
             ref_pc = (self.reference.pandas_categorical
                       if self.reference is not None else None)
+            if self.reference is not None and ref_pc is None:
+                import pandas as pd
+                if any(isinstance(dt, pd.CategoricalDtype)
+                       for dt in data.dtypes):
+                    # coding against the valid frame's OWN level order would
+                    # silently misalign with the training values (same guard
+                    # as Booster.predict below)
+                    raise LightGBMError(
+                        "validation DataFrame has category-dtype columns but "
+                        "the reference Dataset carries no pandas_categorical "
+                        "mapping (it was not built from a pandas DataFrame "
+                        "with category columns)")
             data, df_names, cat_spec, self.pandas_categorical = \
                 _pandas_to_numpy(data, self.categorical_feature, ref_pc)
             if self.feature_name == "auto":

@@ -14,6 +14,7 @@ layouts feeding the MXU), and histogram dispatch is a JAX op in
 """
 from __future__ import annotations
 
+import sys
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -645,9 +646,18 @@ def _sanitize_feature_names(names: "List[str]") -> "List[str]":
 
 
 def _is_dataframe(data) -> bool:
-    """True for a pandas DataFrame without importing pandas eagerly."""
-    return hasattr(data, "dtypes") and hasattr(data, "columns") \
-        and hasattr(data, "values")
+    """True only for an actual ``pandas.DataFrame`` (the reference checks the
+    concrete type too, ``python-package/lightgbm/compat.py:22``).  The duck
+    check alone would route look-alike frames (cudf, polars-with-pandas-api)
+    into ``_pandas_to_numpy``, which assumes pandas semantics; those fall
+    back to the generic ``.values``/asarray path instead."""
+    if not (hasattr(data, "dtypes") and hasattr(data, "columns")
+            and hasattr(data, "values")):
+        return False
+    pd = sys.modules.get("pandas")
+    if pd is None:           # pandas never imported => cannot be a pandas DF
+        return False
+    return isinstance(data, pd.DataFrame)
 
 
 def _pandas_to_numpy(df, categorical_feature="auto", pandas_categorical=None):

@@ -117,9 +117,11 @@ def parse_pandas_categorical(text: str):
     pos = text.rfind("\n" + tag)
     if pos < 0:
         return None
-    payload = text[pos + 1 + len(tag):].splitlines()[0]
+    lines = text[pos + 1 + len(tag):].splitlines()
+    if not lines:            # file truncated right after the tag
+        return None
     try:
-        return json.loads(payload)
+        return json.loads(lines[0])
     except json.JSONDecodeError:
         return None
 

@@ -44,7 +44,7 @@ import numpy as np
 
 from ..config import Config
 from ..io.distributed import distributed_dataset
-from ..utils.log import Log, check
+from ..utils.log import Log, LightGBMError, check
 from ..utils.random_gen import key_for_iteration
 from .data_parallel import make_dp_train_step
 from .mesh import DATA_AXIS
@@ -78,18 +78,48 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
     if _is_dataframe(data):
         # category-dtype columns -> training codes, like Dataset.construct;
         # the category lists ride to the returned Booster so predict on a
-        # DataFrame re-codes against them.  NOTE: the lists come from THIS
-        # process's shard — with category dtypes the caller must use
-        # identical dtypes on every rank (same levels, same order), which
-        # pandas enforces naturally when shards come from one parent frame.
+        # DataFrame re-codes against them.  The lists come from THIS
+        # process's shard; cross-rank consistency is verified below.
         from ..io.dataset import _pandas_to_numpy
         data, df_names, cat_spec, pandas_categorical = _pandas_to_numpy(
             data, categorical_feature if categorical_feature is not None
             else "auto", None)
         feature_name = feature_name or df_names
         categorical_feature = None if cat_spec == "auto" else cat_spec
+    if jax.process_count() > 1:
+        # Shards whose category dtypes differ (levels cast per-shard, or a
+        # level absent on one rank) would silently produce different codes
+        # for the same value on different ranks.  Gather a digest of the
+        # lists and fail loudly on divergence instead.
+        import hashlib
+        import json as _json
+        from jax.experimental import multihost_utils as _mhu
+        digest = hashlib.sha256(
+            _json.dumps(pandas_categorical, default=str).encode()
+        ).digest()[:8]
+        # int32 chunks: jax default x64-disabled would silently truncate int64
+        mine = np.frombuffer(digest, dtype=np.int32)
+        everyone = np.asarray(_mhu.process_allgather(mine))
+        if not (everyone == mine[None, :]).all():
+            raise LightGBMError(
+                "pandas categorical levels differ across processes: every "
+                "rank must see identical category dtypes (same levels, same "
+                "order). Cast columns to a shared CategoricalDtype before "
+                "sharding.")
     if valid_data is not None and _is_dataframe(valid_data[0]):
         from ..io.dataset import _pandas_to_numpy
+        if pandas_categorical is None:
+            import pandas as pd
+            if any(isinstance(dt, pd.CategoricalDtype)
+                   for dt in valid_data[0].dtypes):
+                # no training mapping: each rank would code against its own
+                # local levels — the silent cross-rank divergence the digest
+                # above guards against
+                raise LightGBMError(
+                    "validation DataFrame has category-dtype columns but the "
+                    "training data carried no pandas_categorical mapping; "
+                    "pass the training data as a DataFrame with the same "
+                    "category dtypes")
         valid_data = (_pandas_to_numpy(valid_data[0], "auto",
                                        pandas_categorical)[0],
                       valid_data[1])

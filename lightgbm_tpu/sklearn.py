@@ -181,8 +181,9 @@ class LGBMModel(_SKLBase):
             verbose: Any = False):
         if not _is_sparse(X) and not _is_dataframe(X):
             # DataFrames pass through untouched so Dataset's pandas path
-            # (category-dtype -> codes, auto feature names) applies
-            X = np.asarray(X, dtype=np.float64)
+            # (category-dtype -> codes, auto feature names) applies;
+            # non-pandas frame look-alikes contribute their .values
+            X = np.asarray(getattr(X, "values", X), dtype=np.float64)
         y = np.asarray(y).ravel()
         self._n_features = X.shape[1]
         params = self._lgb_params()
@@ -205,18 +206,25 @@ class LGBMModel(_SKLBase):
         valid_sets, valid_names = [], []
         if eval_set is not None:
             for i, (vX, vy) in enumerate(eval_set):
-                if not _is_sparse(vX):
-                    vX = np.asarray(vX, dtype=np.float64)
+                if not _is_sparse(vX) and not _is_dataframe(vX):
+                    # DataFrames stay intact: Dataset(reference=train_set)
+                    # re-codes category dtypes against the training mapping
+                    vX = np.asarray(getattr(vX, "values", vX), dtype=np.float64)
                 vy = np.asarray(vy).ravel()
-                if vX is X or (not _is_sparse(vX)
-                               and not _is_sparse(X)
-                               and vX.shape == X.shape
-                               and np.array_equal(vX, X)):
+                same_X = vX is X or (not _is_sparse(vX) and not _is_dataframe(vX)
+                                     and not _is_sparse(X) and not _is_dataframe(X)
+                                     and vX.shape == X.shape
+                                     and np.array_equal(vX, X))
+                # the reference wrapper reuses the train set only when BOTH
+                # X and y match (same X with held-out labels is a distinct
+                # eval set); compare in encoded space, y is already encoded
+                vy_enc = np.asarray(self._prep_eval_label(vy)).ravel()
+                if same_X and np.array_equal(vy_enc, y):
                     valid_sets.append(train_set)
                 else:
                     vw = eval_sample_weight[i] if eval_sample_weight else None
                     vg = eval_group[i] if eval_group else None
-                    valid_sets.append(Dataset(vX, label=self._prep_eval_label(vy),
+                    valid_sets.append(Dataset(vX, label=vy_enc,
                                               weight=vw, group=vg,
                                               reference=train_set))
                 valid_names.append(eval_names[i] if eval_names else f"valid_{i}")
@@ -241,7 +249,7 @@ class LGBMModel(_SKLBase):
                 pred_contrib: bool = False, **kwargs):
         self._check_fitted()
         if not _is_sparse(X) and not _is_dataframe(X):
-            X = np.asarray(X, dtype=np.float64)
+            X = np.asarray(getattr(X, "values", X), dtype=np.float64)
         if X.shape[1] != self._n_features:
             raise LightGBMError(
                 f"Number of features of the model must match the input. Model "

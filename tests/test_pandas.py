@@ -180,3 +180,109 @@ def test_sklearn_wrapper_accepts_dataframe():
     assert proba.shape == (len(df), 2)
     from sklearn.metrics import roc_auc_score
     assert roc_auc_score(y, proba[:, 1]) > 0.7
+
+
+def test_sklearn_eval_set_dataframe_recodes():
+    """eval_set frames must flow through the pandas path (advisor r4):
+    category columns re-coded against the TRAINING levels, so a validation
+    frame with reordered levels scores identically."""
+    from lightgbm_tpu.sklearn import LGBMClassifier
+    df, y = _frame(1000)
+    tr, va = df.iloc[:800], df.iloc[800:].copy()
+    ytr, yva = y[:800], y[800:]
+    # same values, different level ORDER: raw codes would misalign
+    va["color"] = va["color"].cat.reorder_categories(["green", "blue", "red"])
+    est = LGBMClassifier(n_estimators=15, num_leaves=15, verbose=-1)
+    est.fit(tr, ytr, eval_set=[(va, yva)], eval_metric="auc")
+    auc_cb = est.evals_result_["valid_0"]["auc"][-1]
+    from sklearn.metrics import roc_auc_score
+    auc_direct = roc_auc_score(yva, est.predict_proba(df.iloc[800:])[:, 1])
+    assert auc_cb == pytest.approx(auc_direct, abs=1e-9)
+
+
+def test_sklearn_eval_set_same_frame_dedups_to_train_set():
+    """(df, y) identical to the training pair reuses the train Dataset;
+    same X with DIFFERENT labels must NOT dedup — the metric has to be
+    computed against the labels the caller passed."""
+    from sklearn.metrics import roc_auc_score
+    from lightgbm_tpu.sklearn import LGBMClassifier
+    df, y = _frame()
+    est = LGBMClassifier(n_estimators=5, num_leaves=15, verbose=-1)
+    est.fit(df, y, eval_set=[(df, y)], eval_metric="auc")
+    # dedup routes through the training-metric path, not a fresh Dataset
+    assert "valid_0" in est.evals_result_
+    assert not getattr(est._Booster, "valid_sets_py", [])
+
+    y_other = 1.0 - y
+    est2 = LGBMClassifier(n_estimators=5, num_leaves=15, verbose=-1)
+    est2.fit(df, y, eval_set=[(df, y_other)], eval_metric="auc")
+    auc_cb = est2.evals_result_["valid_0"]["auc"][-1]
+    auc_direct = roc_auc_score(y_other, est2.predict_proba(df)[:, 1])
+    assert auc_cb == pytest.approx(auc_direct, abs=1e-9)
+
+
+def test_non_pandas_frame_lookalike_uses_values():
+    """A duck-typed non-pandas frame (cudf-like) must NOT enter the pandas
+    path (advisor r4); it falls back to .values."""
+    df, y = _frame()
+    arr = _codes_matrix(df)
+
+    class FakeFrame:
+        dtypes = df.dtypes
+        columns = list(df.columns)
+        values = arr
+        @property
+        def shape(self):
+            return arr.shape
+
+    bst = lgb.train(_PARAMS, lgb.Dataset(arr, label=y), 5)
+    np.testing.assert_allclose(bst.predict(FakeFrame()), bst.predict(arr))
+
+
+def test_truncated_pandas_categorical_payload():
+    from lightgbm_tpu.models.model_io import parse_pandas_categorical
+    assert parse_pandas_categorical("tree\n...\npandas_categorical:") is None
+    assert parse_pandas_categorical("x\npandas_categorical:\n") is None
+    assert parse_pandas_categorical(
+        "x\npandas_categorical:[[\"a\"]]\n") == [["a"]]
+
+
+def test_eval_set_cat_frame_without_train_mapping_raises():
+    """Train on an ndarray, eval on a category-dtype frame: there is no
+    stored mapping to code against -> loud error, not silent miscoding."""
+    from lightgbm_tpu.sklearn import LGBMClassifier
+    df, y = _frame()
+    arr = _codes_matrix(df)
+    est = LGBMClassifier(n_estimators=5, num_leaves=15, verbose=-1)
+    with pytest.raises(lgb.LightGBMError, match="pandas_categorical"):
+        est.fit(arr, y, eval_set=[(df, y)], eval_metric="auc")
+
+
+def test_classifier_string_labels_dedup_eval_set():
+    """String class labels: dedup must compare in encoded space (advisor
+    follow-up) so (X, y) identical to training still reuses the train set."""
+    from lightgbm_tpu.sklearn import LGBMClassifier
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(400, 5))
+    y = np.where(X[:, 0] + rng.normal(scale=.5, size=400) > 0, "pos", "neg")
+    est = LGBMClassifier(n_estimators=5, num_leaves=7, verbose=-1)
+    est.fit(X, y, eval_set=[(X, y)], eval_metric="auc")
+    assert not getattr(est._Booster, "valid_sets_py", [])
+    assert "valid_0" in est.evals_result_
+
+
+def test_sklearn_lookalike_frame_values_fallback():
+    from lightgbm_tpu.sklearn import LGBMRegressor
+    rng = np.random.default_rng(4)
+    arr = rng.normal(size=(300, 4))
+    y = arr[:, 0] * 2 + rng.normal(scale=.1, size=300)
+
+    class FakeFrame:
+        dtypes = None
+        columns = list("abcd")
+        values = arr
+        shape = arr.shape
+
+    est = LGBMRegressor(n_estimators=5, num_leaves=7, verbose=-1)
+    est.fit(FakeFrame(), y)
+    np.testing.assert_allclose(est.predict(FakeFrame()), est.predict(arr))
