@@ -97,18 +97,9 @@ class Dataset:
                 self.reference.construct()
             ref_pc = (self.reference.pandas_categorical
                       if self.reference is not None else None)
-            if self.reference is not None and ref_pc is None:
-                import pandas as pd
-                if any(isinstance(dt, pd.CategoricalDtype)
-                       for dt in data.dtypes):
-                    # coding against the valid frame's OWN level order would
-                    # silently misalign with the training values (same guard
-                    # as Booster.predict below)
-                    raise LightGBMError(
-                        "validation DataFrame has category-dtype columns but "
-                        "the reference Dataset carries no pandas_categorical "
-                        "mapping (it was not built from a pandas DataFrame "
-                        "with category columns)")
+            if self.reference is not None:
+                from .io.dataset import _require_pandas_mapping
+                _require_pandas_mapping(data, ref_pc, "validation DataFrame")
             data, df_names, cat_spec, self.pandas_categorical = \
                 _pandas_to_numpy(data, self.categorical_feature, ref_pc)
             if self.feature_name == "auto":
@@ -665,19 +656,9 @@ class Booster:
                                (0, self.num_feature() - data.shape[1])))
         from .io.dataset import _is_dataframe, _is_sparse
         if _is_dataframe(data):
-            from .io.dataset import _pandas_to_numpy
-            import pandas as pd
+            from .io.dataset import _pandas_to_numpy, _require_pandas_mapping
             pc = getattr(self, "pandas_categorical", None)
-            has_cats = any(isinstance(dt, pd.CategoricalDtype)
-                           for dt in data.dtypes)
-            if has_cats and pc is None:
-                # silently re-deriving codes from the prediction frame's
-                # own level order would misalign with training (the
-                # reference raises here too)
-                raise LightGBMError(
-                    "cannot predict on a DataFrame with category-dtype "
-                    "columns: the model carries no pandas_categorical "
-                    "mapping (it was not trained on a pandas DataFrame)")
+            _require_pandas_mapping(data, pc, "prediction DataFrame")
             # re-code category columns against the TRAINING category lists
             # (unseen values -> NaN), like the reference's predictor
             data = _pandas_to_numpy(data, "auto", pc)[0]

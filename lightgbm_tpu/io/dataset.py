@@ -660,6 +660,22 @@ def _is_dataframe(data) -> bool:
     return isinstance(data, pd.DataFrame)
 
 
+def _df_has_category_columns(df) -> bool:
+    import pandas as pd
+    return any(isinstance(dt, pd.CategoricalDtype) for dt in df.dtypes)
+
+
+def _require_pandas_mapping(df, pandas_categorical, what: str) -> None:
+    """Raise when ``df`` carries category-dtype columns but no training
+    mapping exists to code them against — coding against the frame's OWN
+    level order would silently misalign with the training values."""
+    if pandas_categorical is None and _df_has_category_columns(df):
+        raise LightGBMError(
+            f"{what} has category-dtype columns but no pandas_categorical "
+            "mapping is available (the training data was not a pandas "
+            "DataFrame with category columns)")
+
+
 def _pandas_to_numpy(df, categorical_feature="auto", pandas_categorical=None):
     """Convert a pandas DataFrame to the float64 matrix the binner ingests
     (the analog of the reference's ``_data_from_pandas``,

@@ -73,8 +73,11 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
     rounds = (num_boost_round if num_boost_round is not None
               else cfg.num_iterations)
 
-    from ..io.dataset import _is_dataframe
+    from ..io.dataset import (_df_has_category_columns, _is_dataframe,
+                              _require_pandas_mapping)
     pandas_categorical = None
+    valid_is_df = valid_data is not None and _is_dataframe(valid_data[0])
+    valid_has_cats = valid_is_df and _df_has_category_columns(valid_data[0])
     if _is_dataframe(data):
         # category-dtype columns -> training codes, like Dataset.construct;
         # the category lists ride to the returned Booster so predict on a
@@ -94,9 +97,14 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
         import hashlib
         import json as _json
         from jax.experimental import multihost_utils as _mhu
+        # the no-mapping guard's raise PREDICATE rides in the digest so it
+        # fires on EVERY rank or none (a rank-local raise would leave the
+        # others blocked in the next collective); the raw flag would reject
+        # legitimate mixed container types when a mapping exists
+        valid_would_raise = pandas_categorical is None and valid_has_cats
         digest = hashlib.sha256(
-            _json.dumps(pandas_categorical, default=str).encode()
-        ).digest()[:8]
+            _json.dumps([pandas_categorical, valid_would_raise], default=str)
+            .encode()).digest()[:8]
         # int32 chunks: jax default x64-disabled would silently truncate int64
         mine = np.frombuffer(digest, dtype=np.int32)
         everyone = np.asarray(_mhu.process_allgather(mine))
@@ -106,20 +114,12 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
                 "rank must see identical category dtypes (same levels, same "
                 "order). Cast columns to a shared CategoricalDtype before "
                 "sharding.")
-    if valid_data is not None and _is_dataframe(valid_data[0]):
+    if valid_is_df:
         from ..io.dataset import _pandas_to_numpy
-        if pandas_categorical is None:
-            import pandas as pd
-            if any(isinstance(dt, pd.CategoricalDtype)
-                   for dt in valid_data[0].dtypes):
-                # no training mapping: each rank would code against its own
-                # local levels — the silent cross-rank divergence the digest
-                # above guards against
-                raise LightGBMError(
-                    "validation DataFrame has category-dtype columns but the "
-                    "training data carried no pandas_categorical mapping; "
-                    "pass the training data as a DataFrame with the same "
-                    "category dtypes")
+        # after the digest gather, every rank agrees on both inputs to this
+        # guard, so it raises everywhere or nowhere
+        _require_pandas_mapping(valid_data[0], pandas_categorical,
+                                "validation DataFrame")
         valid_data = (_pandas_to_numpy(valid_data[0], "auto",
                                        pandas_categorical)[0],
                       valid_data[1])

@@ -217,13 +217,16 @@ class LGBMModel(_SKLBase):
                                      and np.array_equal(vX, X))
                 # the reference wrapper reuses the train set only when BOTH
                 # X and y match (same X with held-out labels is a distinct
-                # eval set); compare in encoded space, y is already encoded
+                # eval set); compare in encoded space, y is already encoded.
+                # A caller-supplied eval weight/group also forces a real
+                # eval Dataset — reusing train_set would drop them.
                 vy_enc = np.asarray(self._prep_eval_label(vy)).ravel()
-                if same_X and np.array_equal(vy_enc, y):
+                vw = eval_sample_weight[i] if eval_sample_weight else None
+                vg = eval_group[i] if eval_group else None
+                if (same_X and np.array_equal(vy_enc, y)
+                        and vw is None and vg is None):
                     valid_sets.append(train_set)
                 else:
-                    vw = eval_sample_weight[i] if eval_sample_weight else None
-                    vg = eval_group[i] if eval_group else None
                     valid_sets.append(Dataset(vX, label=vy_enc,
                                               weight=vw, group=vg,
                                               reference=train_set))

@@ -46,3 +46,27 @@ def multiclass_data():
     X, y = make_classification(n_samples=2400, n_features=12, n_informative=8,
                                n_classes=4, n_clusters_per_class=1, random_state=3)
     return X[:1800], y[:1800], X[1800:], y[1800:]
+
+
+# --- quick tier -------------------------------------------------------------
+# `pytest -m quick` runs a <3-minute cross-section (kernel unit tests, native
+# parser, param docs, plus one smoke test per major surface) so hardware
+# windows aren't spent on the full ~1h suite.  Whole fast modules + named
+# smoke tests; anything unlisted is excluded.
+_QUICK_MODULES = {"test_ops", "test_native", "test_param_docs"}
+_QUICK_TESTS = {
+    ("test_engine", "test_binary"),
+    ("test_engine", "test_early_stopping"),
+    ("test_sklearn", "test_classifier_binary"),
+    ("test_booster_api", "test_attr_roundtrip"),
+    ("test_frontier", "test_regression_weighted_parity"),
+    ("test_pandas", "test_dataframe_train_matches_manual_codes"),
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        name = item.originalname if hasattr(item, "originalname") else item.name
+        if mod in _QUICK_MODULES or (mod, name) in _QUICK_TESTS:
+            item.add_marker(pytest.mark.quick)
