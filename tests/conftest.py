@@ -53,7 +53,7 @@ def multiclass_data():
 # parser, param docs, plus one smoke test per major surface) so hardware
 # windows aren't spent on the full ~1h suite.  Whole fast modules + named
 # smoke tests; anything unlisted is excluded.
-_QUICK_MODULES = {"test_ops", "test_native", "test_param_docs"}
+_QUICK_MODULES = {"test_ops", "test_native", "test_param_docs", "test_bench"}
 _QUICK_TESTS = {
     ("test_engine", "test_binary"),
     ("test_engine", "test_early_stopping"),

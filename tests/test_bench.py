@@ -1,0 +1,38 @@
+"""Bench harness invariants: the standalone AUC in scripts/bench_vs_ref.py
+(kept jax-free so the script can't touch a wedged tunnel) must agree exactly
+with the package's AUCMetric that bench.py gates on — the 0.002-slack
+head-to-head comparison feeds on both."""
+import importlib.util
+import os
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench_vs_ref():
+    spec = importlib.util.spec_from_file_location(
+        "bench_vs_ref", os.path.join(REPO, "scripts", "bench_vs_ref.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_script_auc_matches_package_metric():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata
+    from lightgbm_tpu.metric.base import AUCMetric
+
+    script_auc = _load_bench_vs_ref()._auc
+    rng = np.random.default_rng(0)
+    for n, tie in [(500, False), (500, True), (50, True)]:
+        y = (rng.random(n) > 0.4).astype(np.float64)
+        s = rng.normal(size=n)
+        if tie:                      # heavy ties exercise the midrank path
+            s = np.round(s, 1)
+        md = Metadata(n)
+        md.set_field("label", y)
+        m = AUCMetric(Config())
+        m.init(md, n)
+        (_, pkg, _), = m.eval(s.astype(np.float64))
+        np.testing.assert_allclose(script_auc(y, s), pkg, atol=1e-12)
