@@ -177,10 +177,20 @@ def main() -> None:
         with open(_h2h) as _f:
             _table = json.load(_f)
         _e = _table.get(str(n_rows))
-        # the holdout must match too: AUC noise across different-size
-        # holdouts exceeds the 0.002 slack
+        # every accuracy-relevant knob must match the reference run: the
+        # holdout (AUC noise across sizes exceeds the 0.002 slack), the
+        # ensemble size, the leaf budget, and BENCH_PARAMS_EXTRA limited to
+        # KNOWN perf-only knobs (allowlist: anything else may move accuracy)
+        _perf_keys = {"tree_grower", "frontier_k", "frontier_block_rows",
+                      "hist_method", "hist_chunk_rows", "force_col_wise",
+                      "force_row_wise", "hist_compact",
+                      "hist_compact_ladder", "num_threads"}
+        _extra_ok = set(json.loads(os.environ.get(
+            "BENCH_PARAMS_EXTRA", "{}"))) <= _perf_keys
         if (_e and _e.get("iters") == n_warmup + n_iters
-                and _e.get("valid_rows") == n_valid):
+                and _e.get("valid_rows") == n_valid
+                and _e.get("num_leaves", 255) == num_leaves
+                and _extra_ok):
             auc_floor = _e["ref_auc_holdout"] - 0.002     # VERDICT r4 item 6
             ref_detail = {"ref_auc": _e["ref_auc_holdout"],
                           "ref_sec_per_tree_local": _e["ref_sec_per_tree"],

@@ -35,11 +35,14 @@ amortizes the sequential tail (per-split small-op overhead, ~33% of round-3
 tree time) and halves gather traffic (only smaller-sibling rows are ever
 row-gathered; partition decisions ride a byte-sized element gather).
 
-Scope: serial and data-parallel modes without cross-leaf-coupled features.
-Monotone constraints, CEGB, interaction constraints, forced splits,
-extra-trees and per-node feature sampling couple leaves to split order (or
-to the split step's RNG stream) and take the sequential grower
-(``grower.grow_tree``); ``grower._frontier_eligible`` is the gate.
+Scope: serial, data-, feature- and voting-parallel modes without
+cross-leaf-COUPLED features.  Monotone constraints, CEGB, interaction
+constraints and forced splits couple leaves to the sequential split order
+and take the sequential grower (``grower.grow_tree``);
+``grower._frontier_eligible`` is the gate.  Per-node RNG features
+(``feature_fraction_bynode``, ``extra_trees``) ARE served here: their draws
+are re-keyed by split-record index (see ``node_mask_for``), giving a valid
+stream of the same structure as the sequential grower's step-keyed one.
 """
 from __future__ import annotations
 
@@ -153,7 +156,6 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         nan_bins_l = lslice(nan_bins)
         is_cat_l = lslice(is_categorical)
         mono_l = lslice(monotone)
-        fmask_l = lslice(feature_mask)
         contri_l = (lslice(feature_contri) if feature_contri is not None
                     else None)
 
@@ -162,34 +164,64 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         # stores (voting reduces only ELECTED slices inside the search)
         return jax.lax.psum(h, axis) if mode == "data" else h
 
-    def find(hist_fb, sum_g, sum_h, count):
+    # --- per-node RNG streams (feature_fraction_bynode, extra_trees) ------
+    # The sequential grower keys both draws by the split-step index; the
+    # frontier keys them by the expansion's split-record index s_idx (root =
+    # step 0, children of record i = step i+1) — a deterministic, replay-
+    # stable stream with the same structure (siblings share a draw, every
+    # split event gets a fresh one), though not bit-identical to the
+    # sequential grower's stream (the pop order differs, so no keying can
+    # reproduce it without sequentializing).
+    bynode = cfg.feature_fraction_bynode < 1.0
+    _nb_r = None
+    if cfg.extra_trees:
+        _nb_r = num_bins_l if mode == "feature" else num_bins
+        _nanb_r = nan_bins_l if mode == "feature" else nan_bins
+
+    def node_mask_for(step):
+        if not bynode:
+            return feature_mask
+        from .grower import node_feature_mask_for
+        return node_feature_mask_for(key, step, feature_mask,
+                                     cfg.feature_fraction_bynode)
+
+    def rand_thr_for(step):
+        if not cfg.extra_trees:
+            return None
+        from .grower import rand_thresholds_for
+        return rand_thresholds_for(key, step, cfg.extra_seed, _nb_r, _nanb_r)
+
+    def find(hist_fb, sum_g, sum_h, count, fmask=None, rand=None):
+        fmask = feature_mask if fmask is None else fmask
         if mode == "feature":
             from .grower import _reduce_split_global
             s = find_best_split(hist_fb, num_bins_l, default_bins_l,
                                 nan_bins_l, is_cat_l, mono_l, sum_g, sum_h,
-                                count, p, fmask_l,
+                                count, p, lslice(fmask), rand_threshold=rand,
                                 sorted_cat=cfg.sorted_cat, contri=contri_l)
             s = s._replace(feature=s.feature + f_start)
             return _reduce_split_global(s, axis)
         if mode == "voting":
-            return _find_voting(hist_fb, sum_g, sum_h, count)
+            return _find_voting(hist_fb, sum_g, sum_h, count, fmask, rand)
         return find_best_split(hist_fb, num_bins, default_bins, nan_bins,
                                is_categorical, monotone, sum_g, sum_h, count,
-                               p, feature_mask, sorted_cat=cfg.sorted_cat,
+                               p, fmask, rand_threshold=rand,
+                               sorted_cat=cfg.sorted_cat,
                                contri=feature_contri)
 
-    def _find_voting(hist, sum_g, sum_h, count):
+    def _find_voting(hist, sum_g, sum_h, count, fmask, rand=None):
         """Local top-k proposal -> global vote -> reduce only elected
         histograms (the election dataflow lives once in split.voting_elect,
         shared with the sequential grower)."""
         from .split import voting_elect
         hist_e, emask = voting_elect(
             hist, num_bins, nan_bins, is_categorical, monotone, sum_g,
-            sum_h, count, p, feature_mask, axis, cfg.top_k, cfg.num_shards,
+            sum_h, count, p, fmask, axis, cfg.top_k, cfg.num_shards,
             sorted_cat=cfg.sorted_cat, contri=feature_contri)
         return find_best_split(hist_e, num_bins, default_bins, nan_bins,
                                is_categorical, monotone, sum_g, sum_h, count,
-                               p, emask, sorted_cat=cfg.sorted_cat,
+                               p, emask, rand_threshold=rand,
+                               sorted_cat=cfg.sorted_cat,
                                contri=feature_contri)
 
     # ---- degenerate: no usable features -> single-leaf tree ---------------
@@ -226,7 +258,8 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
     if mode in ("data", "voting"):
         # feature mode replicates rows, so local sums are already global
         tot = jax.lax.psum(tot, axis)
-    root_split = find(expand_hist(root_hist), tot[0], tot[1], tot[2])
+    root_split = find(expand_hist(root_hist), tot[0], tot[1], tot[2],
+                      fmask=node_mask_for(0), rand=rand_thr_for(0))
 
     # histogram blocks ladder: rungs over the per-round leaf-grouped gather
     # capacity (block-aligned); every rung a BR multiple
@@ -480,9 +513,19 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         g2 = jnp.concatenate([b.lg[sel], b.rg[sel]])
         h2 = jnp.concatenate([b.lh[sel], b.rh[sel]])
         c2 = jnp.concatenate([b.lc[sel], b.rc[sel]])
-        s2 = jax.vmap(lambda hc, g_, h_, c_: find(expand_hist(hc),
-                                                  g_, h_, c_))(
-            hist2, g2, h2, c2)
+        if bynode or cfg.extra_trees:
+            # children of the expansion recorded at s_idx draw their mask /
+            # random thresholds from step s_idx+1 (both siblings share it,
+            # like the sequential grower's per-step draw)
+            steps2 = jnp.concatenate([s_idx, s_idx]) + 1
+            s2 = jax.vmap(lambda hc, g_, h_, c_, st_: find(
+                expand_hist(hc), g_, h_, c_,
+                fmask=node_mask_for(st_), rand=rand_thr_for(st_)))(
+                hist2, g2, h2, c2, steps2)
+        else:
+            s2 = jax.vmap(lambda hc, g_, h_, c_: find(expand_hist(hc),
+                                                      g_, h_, c_))(
+                hist2, g2, h2, c2)
         depth_ok = (cfg.max_depth <= 0) | (depth_c < cfg.max_depth)
         dok2 = jnp.concatenate([depth_ok, depth_ok])
         s2 = s2._replace(gain=jnp.where(dok2, s2.gain, NEG_INF))

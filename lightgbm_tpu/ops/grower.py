@@ -221,14 +221,44 @@ class _BestSplits(NamedTuple):
             cat_bits=u(self.cat_bits, s.cat_bits))
 
 
+def node_feature_mask_for(key, step, feature_mask, frac: float):
+    """Per-node feature subset (reference ``col_sampler.hpp:91`` GetByNode):
+    keep ``max(1, round(frac * F))`` of the still-allowed features, keyed by
+    ``fold_in(key, step)``.  ONE implementation shared by the sequential
+    grower (step = split index) and the frontier grower (step = split-record
+    index) so their streams cannot silently desynchronize in structure."""
+    k = jax.random.fold_in(key, step)
+    f_full = feature_mask.shape[0]
+    n_take = max(1, int(frac * f_full + 0.5))
+    u = jax.random.uniform(k, (f_full,))
+    u = jnp.where(feature_mask > 0, u, -jnp.inf)
+    thresh = jax.lax.top_k(u, n_take)[0][-1]
+    return jnp.where(u >= thresh, feature_mask, 0.0)
+
+
+def rand_thresholds_for(key, step, extra_seed: int, num_bins, nan_bins):
+    """extra_trees: one random valid numeric threshold per feature
+    (reference ExtremelyRandomizedTrees path).  ``extra_seed`` decorrelates
+    the stream from every other seeded draw (Config::extra_seed); a
+    TRAILING missing bin removes the last real threshold (must stay in sync
+    with split.py's valid_t).  Shared by both growers like
+    ``node_feature_mask_for``."""
+    k = jax.random.fold_in(
+        jax.random.fold_in(jax.random.fold_in(key, 7919), step), extra_seed)
+    hi = jnp.maximum(num_bins - 2 - (nan_bins == num_bins - 1), 0)
+    u = jax.random.uniform(k, (num_bins.shape[0],))
+    return jnp.floor(u * (hi + 1).astype(jnp.float32)).astype(jnp.int32)
+
+
 def _frontier_eligible(cfg: "GrowerConfig", n_cols: int, interaction_sets,
                        cegb_coupled, cegb_lazy, forced,
                        efb=None) -> bool:
     """True when the round-batched frontier grower (ops/frontier.py) can
     serve this call.  Cross-leaf-coupled features (monotone bounds, CEGB
-    refunds, interaction branch masks, forced-split prefixes) and
-    split-step-keyed RNG (per-node feature sampling, extra-trees) depend on
-    the sequential split order and take the one-split loop."""
+    refunds, interaction branch masks, forced-split prefixes) depend on the
+    sequential split order and take the one-split loop; per-node RNG
+    features (feature_fraction_bynode, extra_trees) are served by the
+    frontier with a split-record-keyed stream."""
     if cfg.grower_mode == "serial":
         return False
     mode = cfg.parallel_mode or ("data" if cfg.axis_name is not None else None)
@@ -236,8 +266,6 @@ def _frontier_eligible(cfg: "GrowerConfig", n_cols: int, interaction_sets,
           and interaction_sets is None
           and cegb_coupled is None and cegb_lazy is None
           and not forced
-          and not cfg.extra_trees
-          and cfg.feature_fraction_bynode >= 1.0
           and cfg.cegb_split_penalty == 0.0
           and mode in (None, "data", "feature", "voting")
           and (efb is None or mode in (None, "data")))
@@ -575,30 +603,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     def node_feature_mask(step):
         if cfg.feature_fraction_bynode >= 1.0:
             return feature_mask
-        k = jax.random.fold_in(key, step)
-        frac = cfg.feature_fraction_bynode
-        n_take = max(1, int(frac * f_full + 0.5))
-        u = jax.random.uniform(k, (f_full,))
-        u = jnp.where(feature_mask > 0, u, -jnp.inf)
-        thresh = jax.lax.top_k(u, n_take)[0][-1]
-        return jnp.where(u >= thresh, feature_mask, 0.0)
+        return node_feature_mask_for(key, step, feature_mask,
+                                     cfg.feature_fraction_bynode)
 
     def rand_thresholds(step):
-        """extra_trees: one random valid numeric threshold per (node, feature)."""
         if not cfg.extra_trees:
             return None
-        # extra_seed decorrelates the threshold stream from every other
-        # seeded draw (reference Config::extra_seed)
-        k = jax.random.fold_in(
-            jax.random.fold_in(jax.random.fold_in(key, 7919), step),
-            cfg.extra_seed)
-        # a TRAILING missing bin removes the last real threshold; a
-        # mid-range missing bin (zero_as_missing) keeps the full range
-        # (matches split.py's valid_t)
-        hi = jnp.maximum(
-            num_bins_l - 2 - (nan_bins_l == num_bins_l - 1), 0)
-        u = jax.random.uniform(k, (num_bins_l.shape[0],))
-        return jnp.floor(u * (hi + 1).astype(jnp.float32)).astype(jnp.int32)
+        return rand_thresholds_for(key, step, cfg.extra_seed,
+                                   num_bins_l, nan_bins_l)
 
     def gain_mult_for(depth):
         """[F] monotone-split penalty factor at a leaf of ``depth``

@@ -2,10 +2,21 @@
 
 The virtual mesh shares one host's cores, so this measures the COMM/compute
 structure (and that more shards do not regress the program), not real ICI
-speedup — the reference's real-cluster curve is BASELINE.md's Criteo table.
+speedup — the reference's real-cluster curve is its Criteo 1->16-machine
+table (``docs/Experiments.rst:231-239``); ours on real chips awaits a
+multi-chip window.
+
+Per split, the data-parallel learner moves one histogram reduction:
+``psum_scatter`` leaves each shard owning F*B/ndev bins of [grad,hess,count]
+f32, i.e. bytes_on_wire ~= F*B*3*4*(ndev-1)/ndev per shard (ring), vs the
+reference's Reduce-Scatter over the same F*B*3 payload
+(``src/treelearner/data_parallel_tree_learner.cpp:155-173``) — identical
+asymptotic volume; XLA owns the schedule.
 
 usage: python scripts/bench_dp_scaling.py [rows] [features] [leaves]
+Appends one JSON line per shard count to perf_results.jsonl.
 """
+import json
 import os
 import sys
 import time
@@ -16,19 +27,25 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 
 import numpy as np   # noqa: E402
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PERF_LOG = os.path.join(REPO, "perf_results.jsonl")
+
 rows = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
 feats = int(sys.argv[2]) if len(sys.argv) > 2 else 28
 leaves = int(sys.argv[3]) if len(sys.argv) > 3 else 63
+max_bin = 255
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
 import lightgbm_tpu as lgb   # noqa: E402
 
 rng = np.random.default_rng(0)
 X = rng.normal(size=(rows, feats)).astype(np.float32)
 y = (X[:, 0] + X[:, 1] * X[:, 2] + rng.logistic(size=rows) > 0).astype(np.float32)
 
+results = []
 for ndev in (1, 2, 4, 8):
     params = {"objective": "binary", "num_leaves": leaves, "verbose": -1,
+              "max_bin": max_bin,
               "tree_learner": "data" if ndev > 1 else "serial",
               "mesh_shape": [ndev] if ndev > 1 else None,
               "min_data_in_leaf": 50}
@@ -41,4 +58,16 @@ for ndev in (1, 2, 4, 8):
         bst.update()
     bst._gbdt._train_score.block_until_ready()
     dt = (time.perf_counter() - t0) / 5
-    print(f"shards={ndev}:  {dt*1e3:8.1f} ms/tree")
+    # per-shard wire bytes for ONE histogram reduce at this width (ring)
+    wire_mb = feats * max_bin * 3 * 4 * (ndev - 1) / ndev / 1e6
+    results.append({"shards": ndev, "ms_per_tree": round(dt * 1e3, 1),
+                    "reduce_mb_per_split_per_shard": round(wire_mb, 3)})
+    print(f"shards={ndev}:  {dt*1e3:8.1f} ms/tree   "
+          f"(~{wire_mb:.2f} MB/shard on the wire per split reduce)")
+
+entry = {"bench": "dp_scaling_virtual_mesh", "rows": rows, "features": feats,
+         "leaves": leaves, "max_bin": max_bin, "host_cores": os.cpu_count(),
+         "results": results}
+with open(PERF_LOG, "a") as f:
+    f.write(json.dumps(entry) + "\n")
+print("recorded -> perf_results.jsonl")

@@ -130,6 +130,7 @@ def main() -> None:
 
     entry = {
         "rows": args.rows, "iters": args.iters, "valid_rows": args.valid_rows,
+        "num_leaves": conf["num_leaves"],
         "ref_sec_per_tree": round(sec_per_tree, 4),
         "ref_train_sec": round(elapsed[args.iters], 3),
         "ref_load_sec": round(float(load.group(1)), 3) if load else None,
