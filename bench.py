@@ -235,6 +235,20 @@ def main() -> None:
                           "chip": _kind}
         except Exception as e:                       # never fail the bench
             mfu_detail = {"hist_mfu_error": str(e)[:120]}
+        try:
+            # device-memory figures (reference publishes 0.897 GB col-wise
+            # on Higgs, Experiments.rst:166).  peak is PROCESS-lifetime —
+            # inside tpu_perf_suite it includes earlier stages, so the
+            # current in-use figure is the per-config number
+            _ms = _jax.devices()[0].memory_stats() or {}
+            if "bytes_in_use" in _ms:
+                mfu_detail["device_in_use_gb"] = round(
+                    _ms["bytes_in_use"] / 1e9, 3)
+            if "peak_bytes_in_use" in _ms:
+                mfu_detail["device_peak_process_gb"] = round(
+                    _ms["peak_bytes_in_use"] / 1e9, 3)
+        except Exception:
+            pass
     print(json.dumps({
         "metric": "higgs_1m_train_throughput",
         "value": round(row_iters_per_sec / 1e6, 4),

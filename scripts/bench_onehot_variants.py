@@ -67,6 +67,7 @@ def make_kernel(f, Bp, BR, onehot_fn):
             in_specs=[pl.BlockSpec((f, BR), lambda i: (0, i)),
                       pl.BlockSpec((6, BR), lambda i: (0, i))],
             out_specs=pl.BlockSpec((6, f * Bp), lambda i: (0, 0)),
+            interpret=bool(os.environ.get("ONEHOT_INTERPRET")),
         )(bins_t, gh6)
     return run
 
@@ -93,6 +94,15 @@ def onehot_i16cmp(b, f, Bp, BR):
     bi = b.astype(jnp.int16)
     bin_id = jax.lax.broadcasted_iota(jnp.int16, (f, Bp, BR), 1)
     return (bi[:, None, :] == bin_id).astype(jnp.bfloat16)
+
+
+def onehot_u8cmp(b, f, Bp, BR):
+    # 1-byte compare domain (VERDICT r4 item 2: "u8-domain compares upcast
+    # in the dot"): u8 lanes pack 4x vs i32, and Bp=256 exactly spans u8
+    import jax
+    import jax.numpy as jnp
+    bin_id = jax.lax.broadcasted_iota(jnp.uint8, (f, Bp, BR), 1)
+    return (b[:, None, :] == bin_id).astype(jnp.bfloat16)
 
 
 def onehot_sub1abs(b, f, Bp, BR):
@@ -137,10 +147,13 @@ def main():
     variants = [("base_br512", onehot_base, 512),
                 ("bf16cmp_br512", onehot_bf16cmp, 512),
                 ("i16cmp_br512", onehot_i16cmp, 512),
+                ("u8cmp_br512", onehot_u8cmp, 512),
                 ("sub1abs_br512", onehot_sub1abs, 512),
                 ("base_br256", onehot_base, 256),
                 ("base_br1024", onehot_base, 1024),
-                ("base_br2048", onehot_base, 2048)]
+                ("base_br2048", onehot_base, 2048),
+                ("u8cmp_br1024", onehot_u8cmp, 1024),
+                ("u8cmp_br2048", onehot_u8cmp, 2048)]
     for name, fn, BR in variants:
         try:
             run = make_kernel(F, Bp, BR, fn)
