@@ -38,48 +38,55 @@ def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 42):
     return X, y
 
 
-def probe_backend(timeout: float = 300.0, count_devices: bool = False):
+def _load_supervise():
+    """Load ``lightgbm_tpu/utils/supervise.py`` WITHOUT importing the
+    ``lightgbm_tpu`` package: the package __init__ pulls in jax, and the
+    whole point of the probe/watcher layer is to keep jax (and a possibly
+    wedged axon backend) out of the supervising process.  Shared by this
+    bench, scripts/tpu_perf_suite.py, and scripts/tpu_window_watcher.py."""
+    import importlib.util
+    if "_lgbtpu_supervise" in sys.modules:
+        return sys.modules["_lgbtpu_supervise"]
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lightgbm_tpu", "utils", "supervise.py")
+    spec = importlib.util.spec_from_file_location("_lgbtpu_supervise", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod      # dataclasses resolve via sys.modules
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_PROBE_CODE = ("import jax, jax.numpy as jnp;"
+               "(jnp.ones((64,64)) @ jnp.ones((64,64))).block_until_ready();"
+               "print('ndev=%d' % len(jax.devices()))")
+
+
+def probe_backend(timeout: float = 300.0, count_devices: bool = False,
+                  code: str = None, argv: list = None):
     """Probe the ambient backend in a SUBPROCESS (a wedged axon tunnel hangs
     rather than errors): run a trivial matmul and count devices.  Returns
     bool liveness, or the device count (0 = dead) when ``count_devices``.
-    Shared by the bench fallback, scripts/tpu_perf_suite.py, and
-    __graft_entry__.dryrun_multichip.
+    Shared by the bench fallback, scripts/tpu_perf_suite.py, the TPU-window
+    watcher, and __graft_entry__.dryrun_multichip.
 
-    Hardened against the wedge itself: the child runs in its own process
-    group (killpg on timeout reaches any tunnel helper it forked) and writes
-    to a temp file, not a pipe, so a surviving grandchild holding the pipe
-    can't block us after the kill."""
-    import signal
-    import subprocess
-    import tempfile
-    code = ("import jax, jax.numpy as jnp;"
-            "(jnp.ones((64,64)) @ jnp.ones((64,64))).block_until_ready();"
-            "print('ndev=%d' % len(jax.devices()))")
-    with tempfile.TemporaryFile(mode="w+") as out:
-        p = subprocess.Popen([sys.executable, "-c", code], stdout=out,
-                             stderr=subprocess.DEVNULL,
-                             start_new_session=True)
-        try:
-            p.wait(timeout)
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(p.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
-            try:
-                p.wait(5)
-            except subprocess.TimeoutExpired:
-                pass            # unreapable (D-state) child: give up, move on
-            return 0 if count_devices else False
-        out.seek(0)
-        txt = out.read()
+    Hardened against the wedge itself via supervise.run_stage: the child
+    runs in its own process group (killpg on timeout reaches any tunnel
+    helper it forked) and writes to a temp file, not a pipe, so a surviving
+    grandchild holding the pipe can't block us after the kill.  ``code``
+    overrides the probe snippet (fault-injection tests); ``argv`` replaces
+    the whole command (the watcher's fake-backend seam)."""
+    sup = _load_supervise()
+    res = sup.run_stage(
+        "probe", argv or [sys.executable, "-c", code or _PROBE_CODE],
+        timeout=timeout, retries=0)
     ndev = 0
-    for tok in txt.split():
-        if tok.startswith("ndev="):
-            try:
-                ndev = int(tok[5:])
-            except ValueError:
-                pass
+    if res.ok:
+        for tok in res.output_tail.split():
+            if tok.startswith("ndev="):
+                try:
+                    ndev = int(tok[5:])
+                except ValueError:
+                    pass
     return ndev if count_devices else ndev > 0
 
 

@@ -223,16 +223,26 @@ class _BestSplits(NamedTuple):
 
 def node_feature_mask_for(key, step, feature_mask, frac: float):
     """Per-node feature subset (reference ``col_sampler.hpp:91`` GetByNode):
-    keep ``max(1, round(frac * F))`` of the still-allowed features, keyed by
-    ``fold_in(key, step)``.  ONE implementation shared by the sequential
-    grower (step = split index) and the frontier grower (step = split-record
-    index) so their streams cannot silently desynchronize in structure."""
+    keep ``max(1, round(frac * n_allowed))`` of the still-allowed
+    (bytree-selected) features — the fraction applies to the ALLOWED count,
+    not the full width — keyed by ``fold_in(key, step)``.  ONE
+    implementation shared by the sequential grower (step = split index) and
+    the frontier grower (step = split-record index) so their streams cannot
+    silently desynchronize in structure."""
     k = jax.random.fold_in(key, step)
     f_full = feature_mask.shape[0]
-    n_take = max(1, int(frac * f_full + 0.5))
+    allowed = feature_mask > 0
+    # the fraction applies to the STILL-ALLOWED (bytree-selected) subset,
+    # not the full feature count (col_sampler.hpp:94 draws from
+    # used_feature_indices_): sizing from f_full made bynode a silent
+    # no-op whenever feature_fraction < 1 already thinned the mask
+    n_allowed = jnp.sum(allowed.astype(jnp.int32))
+    n_take = jnp.clip(
+        jnp.floor(frac * n_allowed.astype(jnp.float32) + 0.5).astype(
+            jnp.int32), 1, f_full)
     u = jax.random.uniform(k, (f_full,))
-    u = jnp.where(feature_mask > 0, u, -jnp.inf)
-    thresh = jax.lax.top_k(u, n_take)[0][-1]
+    u = jnp.where(allowed, u, -jnp.inf)
+    thresh = jax.lax.top_k(u, f_full)[0][n_take - 1]
     return jnp.where(u >= thresh, feature_mask, 0.0)
 
 

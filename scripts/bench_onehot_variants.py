@@ -25,8 +25,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "perf_results.jsonl")
+# the watcher points every stage at one results file; standalone runs use
+# the repo default
+OUT = os.environ.get("WATCHER_PERF_LOG") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "perf_results.jsonl")
 ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
 
 
@@ -117,6 +120,7 @@ def onehot_sub1abs(b, f, Bp, BR):
 def main():
     import bench
     if "axon" in os.environ.get("JAX_PLATFORMS", "axon") \
+            and not os.environ.get("BENCH_SKIP_PROBE") \
             and not bench.probe_backend(
                 float(os.environ.get("BENCH_PROBE_TIMEOUT", 300))):
         emit(stage="abort", reason="tpu_unreachable")

@@ -37,10 +37,14 @@ REF_BIN = os.environ.get("REF_LGBM_BIN", "/tmp/lgbm_src/lightgbm")
 OUT_JSON = os.path.join(REPO, "docs", "ref_headtohead.json")
 PERF_LOG = os.path.join(REPO, "perf_results.jsonl")
 
-# one row per line, label first (the reference default: label=column 0)
+# one row per line, label first (the reference default: label=column 0).
+# %.9g round-trips float32 bit-exactly (9 significant digits uniquely
+# identify any binary32; %.7g did NOT, so the reference trained on data
+# that differed from ours in the last ulps — weakening the "identical
+# data" head-to-head claim).  tests/test_bench.py locks the round trip.
 def _write_csv(path: str, X: np.ndarray, y: np.ndarray | None) -> None:
     cols = X if y is None else np.column_stack([y, X])
-    np.savetxt(path, cols, delimiter=",", fmt="%.7g")
+    np.savetxt(path, cols, delimiter=",", fmt="%.9g")
 
 
 def _run(cmd, **kw):

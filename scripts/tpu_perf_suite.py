@@ -2,10 +2,27 @@
 
 The axon tunnel can wedge for hours (see README round-3 notes); when a
 recovery window appears, this packs the whole perf story into ONE process
-so nothing is wasted: (1) device sanity, (2) Pallas-vs-onehot histogram
-microbench at the bench shape, (3) grow_tree isolation, (4) the headline
-bench. Results append to ``perf_results.jsonl`` as they land, so a
-mid-run re-wedge still leaves everything completed so far on disk.
+so nothing is wasted.  The suite is a sequence of NAMED PHASES —
+
+    sanity → parity → hist_micro → grow_sweep → headline → headline_big
+
+— each wrapped so a crash records an error and degrades to the next phase
+(parity is the exception: a wrong kernel must abort before any perf number
+is recorded off it).  Results append to ``perf_results.jsonl`` as they
+land, bracketed by resumable markers: ``suite_start`` at entry and one
+``suite_phase_done`` per completed phase, so a mid-run re-wedge leaves an
+exact record of what is still owed.
+
+Resume knobs (used by scripts/tpu_window_watcher.py and by hand):
+  TPU_SUITE_RESUME=1        skip phases with a ``suite_phase_done`` marker
+                            (same row count) since the last ``suite_start``
+  TPU_SUITE_SKIP_PHASES=a,b explicit skip list (wins over resume)
+  TPU_SUITE_ONLY_PHASES=a,b run only these phases
+  TPU_SUITE_SKIP_BIG=1      legacy alias for skipping ``headline_big``
+
+The 10.5M-row headline runs in its OWN subprocess under a wall-clock
+budget (``supervise.run_stage``): an OOM, lowering hang, or wedge there
+must not take down the phases already captured.
 
 Run (ONLY process touching the TPU):
     python scripts/tpu_perf_suite.py [rows]
@@ -17,9 +34,15 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "perf_results.jsonl")
+# the watcher points every stage at one results file; standalone runs use
+# the repo default
+OUT = os.environ.get("WATCHER_PERF_LOG") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "perf_results.jsonl")
 ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+
+PHASES = ("sanity", "parity", "hist_micro", "grow_sweep",
+          "headline", "headline_big")
 
 
 def emit(**kv):
@@ -29,40 +52,93 @@ def emit(**kv):
     print(json.dumps(kv), flush=True)
 
 
-def main():
-    # wedge-safe: prove the backend live in a TIMEOUT-GUARDED subprocess
-    # before this process commits to it (a wedged tunnel hangs forever)
-    import bench
-    if "axon" in os.environ.get("JAX_PLATFORMS", "axon") \
-            and not bench.probe_backend(
-                float(os.environ.get("BENCH_PROBE_TIMEOUT", 300))):
-        emit(stage="abort", reason="tpu_unreachable")
-        return 1
+class SuiteAbort(RuntimeError):
+    """Raised by a phase whose failure poisons everything downstream."""
 
+
+def _completed_phases_since_last_start():
+    """(done, saved): phase names with a ``suite_phase_done`` marker (same
+    row count) since the most recent ``suite_start`` — the resume set —
+    plus any side state a completed phase recorded into its marker (the
+    grow_sweep tuning).  ``resumed_done`` on a suite_start seeds ``done``
+    so a SECOND re-wedge still remembers phases captured two runs ago
+    (deliberate user skips are NOT in that field: a phase skipped by
+    TPU_SUITE_ONLY_PHASES never ran and must not count as landed)."""
+    done, saved = set(), {}
+    try:
+        with open(OUT) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("rows") != ROWS:
+                    continue
+                if rec.get("stage") == "suite_start":
+                    done = set(rec.get("resumed_done") or [])
+                elif rec.get("stage") == "suite_end":
+                    # that run finished: nothing to resume
+                    done, saved = set(), {}
+                elif rec.get("stage") == "suite_phase_done":
+                    done.add(rec.get("phase"))
+                    if rec.get("bench_params_extra") is not None:
+                        saved["bench_params_extra"] = \
+                            rec["bench_params_extra"]
+    except OSError:
+        pass
+    return done, saved
+
+
+def _phases_to_skip(resume_done: set) -> set:
+    skip = set(resume_done)
+    if os.environ.get("TPU_SUITE_SKIP_PHASES"):
+        skip |= {p.strip() for p in
+                 os.environ["TPU_SUITE_SKIP_PHASES"].split(",") if p.strip()}
+    if os.environ.get("TPU_SUITE_SKIP_BIG"):
+        skip.add("headline_big")
+    only = os.environ.get("TPU_SUITE_ONLY_PHASES")
+    if only:
+        keep = {p.strip() for p in only.split(",") if p.strip()}
+        skip |= set(PHASES) - keep
+    return skip
+
+
+# --------------------------------------------------------------------------
+# phases (each takes the shared mutable context dict)
+# --------------------------------------------------------------------------
+
+def phase_sanity(ctx):
     import jax
     import jax.numpy as jnp
-    import numpy as np
-
     t0 = time.perf_counter()
     x = jnp.ones((512, 512))
     (x @ x).block_until_ready()
     emit(stage="sanity", backend=jax.default_backend(),
          secs=round(time.perf_counter() - t0, 2))
 
-    # --- kernel parity FIRST (the r02 lowering crash was only visible on
+
+def phase_parity(ctx):
+    # kernel parity FIRST (the r02 lowering crash was only visible on
     # hardware): both one-hot layouts + the frontier batched-leaf kernel +
-    # grower dual.  A parity failure aborts before any perf number could be
-    # recorded off a wrong kernel.
-    if jax.default_backend() == "tpu":
-        import bench_dual
+    # grower dual.  A parity failure aborts before any perf number could
+    # be recorded off a wrong kernel.
+    import jax
+    if jax.default_backend() != "tpu":
+        emit(stage="dual_skip", reason="cpu backend")
+        return
+    import bench_dual
 
-        def emit_dual(**kv):
-            emit(stage="dual_" + kv.pop("stage", "?"), **kv)
-        if bench_dual.run_checks(emit_dual) != 0:
-            emit(stage="abort", reason="kernel_parity_failed")
-            return 1
+    def emit_dual(**kv):
+        emit(stage="dual_" + kv.pop("stage", "?"), **kv)
+    if bench_dual.run_checks(emit_dual) != 0:
+        raise SuiteAbort("kernel_parity_failed")
 
-    # --- histogram kernels at the bench shape ---------------------------
+
+def phase_hist_micro(ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import bench
     from lightgbm_tpu.ops.histogram import _hist_onehot, _hist_pallas
     rng = np.random.default_rng(0)
     N, F, B = ROWS, 28, 255
@@ -70,6 +146,7 @@ def main():
     g = jnp.asarray(rng.normal(size=N).astype(np.float32))
     h = jnp.asarray(np.full(N, 0.25, np.float32))
     m = jnp.ones(N, jnp.float32)
+    ctx.update(bins=bins, g=g, h=h, m=m, N=N, F=F, B=B)
 
     def timed_jfn(jfn, mk_args, iters=10):
         """Warm once, then average ``iters`` timed calls; ``mk_args(eps)``
@@ -117,9 +194,23 @@ def main():
         b_, g_, h_, m_, B_, 65536))
     emit(stage="hist_onehot", ms=round(t_onehot * 1e3, 3))
 
-    # --- grow_tree isolation at bench shape (255 leaves) ----------------
+
+def phase_grow_sweep(ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
     from lightgbm_tpu.ops.grower import GrowerConfig, grow_tree
     from lightgbm_tpu.ops.split import SplitParams
+    if "bins" not in ctx:             # hist_micro skipped: rebuild inputs
+        rng = np.random.default_rng(0)
+        N, F, B = ROWS, 28, 255
+        ctx.update(
+            bins=jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8)),
+            g=jnp.asarray(rng.normal(size=N).astype(np.float32)),
+            h=jnp.asarray(np.full(N, 0.25, np.float32)),
+            m=jnp.ones(N, jnp.float32), N=N, F=F, B=B)
+    bins, g, h = ctx["bins"], ctx["g"], ctx["h"]
+    N, F = ctx["N"], ctx["F"]
     sp = SplitParams(lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=100,
                      min_sum_hessian_in_leaf=100.0, min_gain_to_split=0.0,
                      max_delta_step=0.0, path_smooth=0.0, cat_smooth=10.0,
@@ -165,47 +256,118 @@ def main():
     emit(stage="frontier_best", k=best[0][0], block_rows=best[0][1],
          ms_per_tree=round(best[1], 1))
     time_grow(cfg._replace(grower_mode="serial"), "serial", iters=2)
-    # merge the sweep winner UNDER any user-provided knobs (theirs win)
-    os.environ["BENCH_PARAMS_EXTRA"] = json.dumps(
-        {"frontier_k": best[0][0], "frontier_block_rows": best[0][1],
-         **json.loads(os.environ.get("BENCH_PARAMS_EXTRA", "{}"))})
+    # merge the sweep winner UNDER any user-provided knobs (theirs win);
+    # returning it records the tuning in this phase's suite_phase_done
+    # marker, so a RESUMED run that skips grow_sweep still benches the
+    # headline with the same knobs instead of silently reverting
+    extra = {"frontier_k": best[0][0], "frontier_block_rows": best[0][1],
+             **json.loads(os.environ.get("BENCH_PARAMS_EXTRA", "{}"))}
+    os.environ["BENCH_PARAMS_EXTRA"] = json.dumps(extra)
+    return {"bench_params_extra": extra}
 
-    # --- headline bench (in-process, same params as bench.py) ----------
-    # one coherent shape for the whole story (a leftover BENCH_ROWS env
-    # var must not decouple the headline from the micro stages); probe
-    # already done above
+
+def phase_headline(ctx):
+    # in-process, same params as bench.py; one coherent shape for the
+    # whole story (a leftover BENCH_ROWS env var must not decouple the
+    # headline from the micro stages); probe already done at entry
     os.environ["BENCH_ROWS"] = str(ROWS)
     os.environ["BENCH_SKIP_PROBE"] = "1"
-    import contextlib, io
+    import contextlib
+    import io
     import bench
 
-    def run_headline(tag):
-        buf = io.StringIO()
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+    except SystemExit:
+        pass          # auc-floor exit: the JSON line is already in buf
+    except Exception as e:
+        # a lowering/OOM failure must still leave a record — the suite's
+        # contract is append-as-they-land
+        emit(stage="headline_bench", error=f"{type(e).__name__}: {e}"[:300])
+        return
+    payload = bench._load_supervise().extract_json_line(buf.getvalue())
+    emit(stage="headline_bench",
+         **(payload if payload is not None
+            else {"error": buf.getvalue()[-300:]}))
+
+
+def phase_headline_big(ctx):
+    # real-Higgs scale: one 10.5M-row single-chip run (VERDICT r4 item 4;
+    # ~0.3 GB of bins) with the device-memory high-water in the detail.
+    # TPU-only, and FAULT-ISOLATED in its own subprocess under a
+    # wall-clock budget: an OOM or lowering hang at this scale must not
+    # take down a suite that already captured everything else.
+    import jax
+    import bench
+    if jax.default_backend() != "tpu":
+        emit(stage="headline_bench_10p5M", skipped="cpu backend")
+        return
+    sup = bench._load_supervise()
+    env = dict(os.environ)
+    env.update(BENCH_ROWS="10500000", BENCH_SKIP_PROBE="1")
+    res = sup.run_stage(
+        "headline_bench_10p5M",
+        [sys.executable, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench.py")],
+        timeout=float(os.environ.get("TPU_SUITE_BIG_TIMEOUT", 2400)),
+        env=env)
+    payload = sup.extract_json_line(res.output_tail)
+    if payload is not None:
+        emit(stage="headline_bench_10p5M", subprocess_status=res.status,
+             **payload)
+    else:
+        emit(stage="headline_bench_10p5M", subprocess_status=res.status,
+             error=res.output_tail[-300:])
+
+
+PHASE_FNS = {"sanity": phase_sanity, "parity": phase_parity,
+             "hist_micro": phase_hist_micro, "grow_sweep": phase_grow_sweep,
+             "headline": phase_headline, "headline_big": phase_headline_big}
+
+
+def main():
+    # wedge-safe: prove the backend live in a TIMEOUT-GUARDED subprocess
+    # before this process commits to it (a wedged tunnel hangs forever)
+    import bench
+    if "axon" in os.environ.get("JAX_PLATFORMS", "axon") \
+            and not os.environ.get("BENCH_SKIP_PROBE") \
+            and not bench.probe_backend(
+                float(os.environ.get("BENCH_PROBE_TIMEOUT", 300))):
+        emit(stage="abort", reason="tpu_unreachable")
+        return 1
+
+    resume_done, saved = (set(), {})
+    if os.environ.get("TPU_SUITE_RESUME"):
+        resume_done, saved = _completed_phases_since_last_start()
+    skip = _phases_to_skip(resume_done)
+    if "grow_sweep" in skip and saved.get("bench_params_extra"):
+        # resuming past a completed sweep: restore its tuning (any
+        # user-provided knobs still win)
+        os.environ["BENCH_PARAMS_EXTRA"] = json.dumps(
+            {**saved["bench_params_extra"],
+             **json.loads(os.environ.get("BENCH_PARAMS_EXTRA", "{}"))})
+    emit(stage="suite_start", rows=ROWS, skipped=sorted(skip),
+         resumed_done=sorted(resume_done))
+    ctx = {}
+    rc = 0
+    for name in PHASES:
+        if name in skip:
+            continue
         try:
-            with contextlib.redirect_stdout(buf):
-                bench.main()
-        except SystemExit:
-            pass          # auc-floor exit: the JSON line is already in buf
-        except Exception as e:
-            # a 10.5M OOM/lowering failure must still leave a record —
-            # the suite's contract is append-as-they-land
-            emit(stage=tag, error=f"{type(e).__name__}: {e}"[:300])
-            return
-        line = [l for l in buf.getvalue().splitlines() if l.startswith("{")]
-        emit(stage=tag,
-             **(json.loads(line[-1]) if line else
-                {"error": buf.getvalue()[-300:]}))
-
-    run_headline("headline_bench")
-
-    # --- real-Higgs scale: one 10.5M-row single-chip run (VERDICT r4
-    # item 4; ~0.3 GB of bins) with the device-memory high-water in the
-    # detail.  TPU-only and opt-out-able: on a slow backend it would burn
-    # the window.
-    if (jax.default_backend() == "tpu"
-            and not os.environ.get("TPU_SUITE_SKIP_BIG")):
-        os.environ["BENCH_ROWS"] = "10500000"
-        run_headline("headline_bench_10p5M")
+            marker_extra = PHASE_FNS[name](ctx) or {}
+        except SuiteAbort as e:
+            emit(stage="abort", reason=str(e), phase=name, rows=ROWS)
+            return 1
+        except Exception as e:       # degrade: later phases still run
+            emit(stage="suite_phase_error", phase=name, rows=ROWS,
+                 error=f"{type(e).__name__}: {e}"[:300])
+            rc = 1
+            continue
+        emit(stage="suite_phase_done", phase=name, rows=ROWS, **marker_extra)
+    emit(stage="suite_end", rows=ROWS, rc=rc)
+    return rc
 
 
 if __name__ == "__main__":

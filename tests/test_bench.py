@@ -18,6 +18,29 @@ def _load_bench_vs_ref():
     return mod
 
 
+def test_csv_roundtrips_float32_bit_exact(tmp_path):
+    """The head-to-head's "identical data" claim requires the CSV handed to
+    the reference binary to reproduce our float32 matrix BIT-exactly:
+    %.9g guarantees that (9 significant digits uniquely identify any
+    binary32); the old %.7g did not."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    # adversarial values: last-ulp neighbors, huge/tiny exponents, denormal
+    X[0, :] = [np.float32(1/3), np.nextafter(np.float32(1/3), np.float32(1)),
+               np.float32(3.4e38), np.float32(1.2e-38)]
+    X[1, :] = [np.float32(1e-45), np.float32(-0.0), np.float32(2**-24),
+               np.nextafter(np.float32(1.0), np.float32(2.0))]
+    y = (rng.random(200) > 0.5).astype(np.float32)
+    path = str(tmp_path / "t.csv")
+    _load_bench_vs_ref()._write_csv(path, X, y)
+    back = np.loadtxt(path, delimiter=",")
+    cols = np.column_stack([y, X])
+    np.testing.assert_array_equal(
+        back.astype(np.float32).view(np.uint32),
+        cols.view(np.uint32),
+        err_msg="CSV write/read must round-trip float32 bit-exactly")
+
+
 def test_script_auc_matches_package_metric():
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import Metadata

@@ -204,3 +204,29 @@ def test_grower_compaction_parity():
                                np.asarray(t2.leaf_value), atol=1e-5)
     np.testing.assert_array_equal(np.asarray(t1.split_feature),
                                   np.asarray(t2.split_feature))
+
+
+def test_node_feature_mask_sizes_from_allowed_subset():
+    """feature_fraction_bynode composes with feature_fraction: the per-node
+    kept count is round(frac * allowed), where allowed is the BYTREE-
+    selected feature count — not the total width (sizing from the total
+    made bynode a silent no-op whenever bytree already thinned the mask,
+    the round-5 advisor bug)."""
+    from lightgbm_tpu.ops.grower import node_feature_mask_for
+    key = jax.random.PRNGKey(42)
+    f_full, n_allowed = 20, 10
+    bytree = jnp.zeros(f_full, jnp.float32).at[:n_allowed].set(1.0)
+    for step in range(5):
+        kept = node_feature_mask_for(key, step, bytree, 0.5)
+        kept_n = int(jnp.sum(kept > 0))
+        assert kept_n == 5, f"step {step}: kept {kept_n}, want 5"
+        # never resurrects a bytree-dropped feature
+        assert int(jnp.sum(kept[n_allowed:] > 0)) == 0
+    # full-width mask keeps the historical round(frac * F) behavior
+    full = jnp.ones(f_full, jnp.float32)
+    assert int(jnp.sum(node_feature_mask_for(key, 0, full, 0.5) > 0)) == 10
+    # floor of one feature even at tiny fractions
+    assert int(jnp.sum(node_feature_mask_for(key, 0, bytree, 0.01) > 0)) == 1
+    # works under jit (n_take must stay traceable)
+    jitted = jax.jit(lambda k, m: node_feature_mask_for(k, 3, m, 0.5))
+    assert int(jnp.sum(jitted(key, bytree) > 0)) == 5
