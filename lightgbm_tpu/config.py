@@ -302,6 +302,12 @@ class Config:
     num_gpu: int = 1
     # TPU-specific knobs (new in this framework):
     hist_chunk_rows: int = 8192               # rows per one-hot matmul chunk
+    # one-hot build strategy for the Pallas histogram kernels: 'auto' (a
+    # one-time cached on-device micro-bench elects the fastest — the TPU
+    # analog of the reference's col/row-wise histogram auto-tuner,
+    # train_share_states.h) or a registry name from ops/onehot_variants.py
+    # (base | bf16cmp | i16cmp | u8cmp | sub1abs | staged | packed | int8)
+    hist_variant: str = "auto"
     # adaptive leaf compaction: gather the smaller sibling's rows into the
     # tightest power-of-4 capacity bucket before histogramming, so per-split
     # cost tracks leaf size (the TPU analog of the reference's per-leaf
@@ -413,6 +419,13 @@ class Config:
                      "refit_tree": "refit"}.get(self.task.lower(), self.task.lower())
 
         self.monotone_constraints_method = self.monotone_constraints_method.lower()
+
+        self.hist_variant = self.hist_variant.lower()
+        from .ops.onehot_variants import VARIANT_NAMES
+        if self.hist_variant not in ("auto",) + VARIANT_NAMES:
+            raise LightGBMError(
+                f"hist_variant must be auto or one of "
+                f"{'/'.join(VARIANT_NAMES)}, got '{self.hist_variant}'")
 
         self.tree_grower = self.tree_grower.lower()
         if self.tree_grower not in ("auto", "serial", "frontier"):

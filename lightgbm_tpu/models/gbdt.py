@@ -241,10 +241,27 @@ class GBDT:
             Log.warning("force_col_wise maps to the scatter histogram "
                         "kernel, which is much slower than the default "
                         "one-hot MXU kernel on TPU")
+        # one-hot build strategy for the pallas kernels: 'auto' runs the
+        # one-time cached on-device micro-bench (ops/onehot_variants.pick_
+        # variant — the reference train_share_states auto-tuner's TPU
+        # analog); an explicit name is validated against the KERNEL bin
+        # width (the EFB bundle width when bundling is on).  Resolved to a
+        # concrete static string HERE, before GrowerConfig exists, so the
+        # compiled tree program never retraces over it.
+        if hist_method == "pallas":
+            from ..ops import onehot_variants as _ov
+            kernel_bins = self._dd.bundle_bins or max_bin
+            if cfg.hist_variant == "auto":
+                hist_variant = _ov.pick_variant(
+                    kernel_bins, self.train_data.num_features)
+            else:
+                hist_variant = _ov.resolve(cfg.hist_variant, kernel_bins)
+        else:
+            hist_variant = "base"           # XLA fallbacks ignore it
         return GrowerConfig(
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth, max_bin=max_bin,
             split=sp, feature_fraction_bynode=cfg.feature_fraction_bynode,
-            hist_method=hist_method,
+            hist_method=hist_method, hist_variant=hist_variant,
             hist_chunk_rows=cfg.hist_chunk_rows,
             cegb_split_penalty=cfg.cegb_tradeoff * cfg.cegb_penalty_split,
             hist_compact=cfg.hist_compact,

@@ -252,7 +252,8 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
     root_hist = reduce_hist(
         build_histogram(bins, grad, hess, row_weight, Bb,
                         method=cfg.hist_method,
-                        chunk_rows=cfg.hist_chunk_rows))
+                        chunk_rows=cfg.hist_chunk_rows,
+                        variant=cfg.hist_variant))
     tot = jnp.stack([jnp.sum(grad * row_weight), jnp.sum(hess * row_weight),
                      jnp.sum(row_weight)])
     if mode in ("data", "voting"):
@@ -490,7 +491,8 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
                 return build_histogram_leaves(
                     combb, ghb[:, 0], ghb[:, 1], m, i_of_blk, k, Bb,
                     method=cfg.hist_method, block_rows=BR,
-                    f_limit=n_cols)[:, :n_cols]
+                    f_limit=n_cols,
+                    variant=cfg.hist_variant)[:, :n_cols]
             return br
 
         idx = jnp.searchsorted(jnp.asarray(caps2, jnp.int32), nb_tot * BR)

@@ -103,6 +103,12 @@ class GrowerConfig(NamedTuple):
     feature_fraction_bynode: float
     hist_method: str          # 'pallas' (TPU) | 'onehot' | 'scatter'
     hist_chunk_rows: int
+    # one-hot build strategy for the pallas kernels: a registry name from
+    # ops/onehot_variants.py (resolved from the user-facing
+    # ``hist_variant`` param — 'auto' is resolved to a concrete name by a
+    # one-time cached on-device micro-bench BEFORE this config is built, so
+    # the compiled tree program never retraces over it)
+    hist_variant: str = "base"
     # data-parallel mesh axis: rows are sharded across this axis and the
     # reference's histogram ReduceScatter + global-sum collectives
     # (data_parallel_tree_learner.cpp:155-173, network.h:168) become a psum
@@ -577,7 +583,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 h = build_histogram(combb, ghb[:, 0], ghb[:, 1], m, Bb,
                                     method=cfg.hist_method,
                                     chunk_rows=cfg.hist_chunk_rows,
-                                    f_limit=n_cols)
+                                    f_limit=n_cols,
+                                    variant=cfg.hist_variant)
                 return new_perm, nleft, h[:n_cols]
             return br
         idx = jnp.searchsorted(jnp.asarray(caps, jnp.int32), rows)
@@ -590,7 +597,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         def full(m):
             return build_histogram(bins, grad, hess, m, Bb,
                                    method=cfg.hist_method,
-                                   chunk_rows=cfg.hist_chunk_rows)
+                                   chunk_rows=cfg.hist_chunk_rows,
+                                   variant=cfg.hist_variant)
 
         if nrows is None or len(caps) == 1:
             h = full(mask)
@@ -600,7 +608,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     bc, gc, hc, mc = gather_rows(bins, grad, hess, m, cap)
                     return build_histogram(bc, gc, hc, mc, Bb,
                                            method=cfg.hist_method,
-                                           chunk_rows=cfg.hist_chunk_rows)
+                                           chunk_rows=cfg.hist_chunk_rows,
+                                           variant=cfg.hist_variant)
                 return br
             branches = [mk(c) for c in caps[:-1]] + [full]
             idx = jnp.searchsorted(jnp.asarray(caps, jnp.int32),

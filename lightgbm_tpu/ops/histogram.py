@@ -22,11 +22,36 @@ import functools
 import jax
 import jax.numpy as jnp
 
+# Shared parity bar for every one-hot/Pallas histogram kernel vs the exact
+# scatter-add (or the true-f32 XLA one-hot): the kernels accumulate a bf16
+# (hi, lo) split-precision pair — or the int8 variant's multi-level
+# quantized pair — whose lo-residual rounding is ~2^-18 per row; summed over ~N/B rows
+# per bin this measures 1.2e-4 at 200k rows on v5e
+# (scripts/debug_bf16_fence2.py).  5e-4 gives shape headroom while still
+# rejecting bare-bf16 accumulation by >200x (the lo-collapse bug class
+# measures ~1e-1 against a true-f32 reference).  The reference side MUST be
+# true f32: _hist_onehot pins precision=HIGHEST internally — at DEFAULT TPU
+# matmul precision it is itself bf16-grade (relerr 0.13 vs the exact
+# scatter-add), which once masked that very bug.  Import this constant
+# everywhere a kernel parity check lives (scripts/bench_dual.py,
+# scripts/bench_onehot_variants.py, tests/test_dual.py,
+# tests/test_onehot_variants.py) — a tolerance re-derived in one place and
+# drifted in another is how the round-4 incident stayed hidden.
+HIST_PARITY_TOL = 5e-4
+
+
+def _pallas_interpret_default() -> bool:
+    """Off-TPU the Pallas kernels run in interpret mode (pure-XLA
+    emulation): the CPU tier-1 suite can parity-check every variant of the
+    PRODUCTION kernels without hardware.  On TPU they lower for real."""
+    return jax.default_backend() != "tpu"
+
 
 def build_histogram(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     mask: jax.Array, max_bin: int, *,
                     method: str = "onehot", chunk_rows: int = 65536,
-                    f_limit: "int | None" = None) -> jax.Array:
+                    f_limit: "int | None" = None,
+                    variant: str = "base") -> jax.Array:
     """Dispatch over histogram kernels; see module docstring.
 
     method: 'pallas' (fused VMEM one-hot, TPU), 'onehot' (XLA matmul),
@@ -35,9 +60,14 @@ def build_histogram(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     f_limit: only the first ``f_limit`` columns carry real bins (the grower
     packs gradient bytes into trailing columns); the pallas kernel skips the
     rest at one-hot build time, the XLA fallbacks return them as garbage for
-    the caller to slice off."""
+    the caller to slice off.
+
+    variant: one-hot build strategy for the pallas kernels (a registry name
+    from ops/onehot_variants.py — lane packing, staged compare, int8 MXU,
+    ...); ignored by the XLA fallbacks."""
     if method == "pallas":
-        return _hist_pallas(bins, grad, hess, mask, max_bin, f_limit=f_limit)
+        return _hist_pallas(bins, grad, hess, mask, max_bin, f_limit=f_limit,
+                            variant=variant)
     return _build_histogram_xla(bins, grad, hess, mask, max_bin,
                                 method=method, chunk_rows=chunk_rows)
 
@@ -146,7 +176,8 @@ def build_histogram_leaves(comb: jax.Array, grad: jax.Array, hess: jax.Array,
                            mask: jax.Array, block_leaf: jax.Array,
                            num_slots: int, max_bin: int, *,
                            method: str = "onehot", block_rows: int = 512,
-                           f_limit: "int | None" = None) -> jax.Array:
+                           f_limit: "int | None" = None,
+                           variant: str = "base") -> jax.Array:
     """Per-leaf histograms of leaf-grouped row blocks — the frontier grower's
     batched analog of ``build_histogram``.
 
@@ -154,8 +185,9 @@ def build_histogram_leaves(comb: jax.Array, grad: jax.Array, hess: jax.Array,
     ``block_rows``-sized blocks, each block belonging to ONE leaf slot
     (``block_leaf[C // block_rows]`` i32, sorted ascending); padded rows
     carry ``mask == 0``.  Returns ``[num_slots, F, B, 3]`` where
-    ``F = f_limit or NC`` (the XLA fallback returns all NC columns, trailing
-    packed-gradient columns as garbage for the caller to slice).
+    ``F = f_limit or NC`` on every path (both the Pallas kernel and the XLA
+    fallback slice the trailing packed-gradient columns off before any
+    histogramming, so neither pays for columns the caller discards).
 
     The Pallas path transposes the gathered rows ONCE in XLA and feeds the
     one-hot MXU kernel ``(f, BR)`` feature-major blocks, with the whole
@@ -172,39 +204,56 @@ def build_histogram_leaves(comb: jax.Array, grad: jax.Array, hess: jax.Array,
     if method == "pallas" and _lanes <= _PALLAS_ROWMAJOR_MAX_LANES \
             and num_slots * 6 * _lanes * 4 <= _PALLAS_LEAFACC_BYTES:
         return _hist_leaves_pallas(comb, grad, hess, mask, block_leaf,
-                                   num_slots, max_bin, block_rows, f)
+                                   num_slots, max_bin, block_rows, f,
+                                   variant=variant)
     # XLA fallback: one scatter-add with the leaf slot folded into the flat
-    # bin index (fast on CPU, correct everywhere)
+    # bin index (fast on CPU, correct everywhere).  The packed-gradient tail
+    # columns are sliced off BEFORE the flat index is built: scattering them
+    # too made the CPU test path pay num_slots * gh_cols * max_bin extra
+    # scatter targets for garbage the caller discarded anyway.
+    comb_f = comb[:, :f] if f < nc else comb
     row_leaf = jnp.repeat(block_leaf, block_rows, total_repeat_length=n)
     gh = jnp.stack([grad * mask, hess * mask, mask], axis=-1)       # [C, 3]
-    clipped = jnp.minimum(comb.astype(jnp.int32), max_bin - 1)
-    flat = (row_leaf[:, None] * (nc * max_bin)
-            + jnp.arange(nc, dtype=jnp.int32)[None, :] * max_bin + clipped)
-    out = jnp.zeros((num_slots * nc * max_bin, 3), jnp.float32)
-    vals = jnp.broadcast_to(gh[:, None, :], (n, nc, 3)).reshape(n * nc, 3)
+    clipped = jnp.minimum(comb_f.astype(jnp.int32), max_bin - 1)
+    flat = (row_leaf[:, None] * (f * max_bin)
+            + jnp.arange(f, dtype=jnp.int32)[None, :] * max_bin + clipped)
+    out = jnp.zeros((num_slots * f * max_bin, 3), jnp.float32)
+    vals = jnp.broadcast_to(gh[:, None, :], (n, f, 3)).reshape(n * f, 3)
     out = out.at[flat.reshape(-1)].add(vals)
-    return out.reshape(num_slots, nc, max_bin, 3)[:, :f]
+    return out.reshape(num_slots, f, max_bin, 3)
 
 
 def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
-                        max_bin, block_rows, f):
+                        max_bin, block_rows, f, variant="base",
+                        interpret=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from .onehot_variants import VARIANTS, feat_geometry, finish_hist
+
+    spec = VARIANTS[variant]
     n, nc = comb.shape
     B = max_bin
     Bp = -(-B // 128) * 128
     BR = block_rows
     assert n % BR == 0 and BR % 128 == 0
     nb = n // BR
+    if interpret is None:
+        interpret = _pallas_interpret_default()
 
-    gh6 = _gh6(grad, hess, mask)                                  # [6, C] bf16
+    f_pad, lanes = feat_geometry(spec, f, B, Bp)   # lane-pack group align
+
+    rows = spec.prep(grad, hess, mask)                        # [R, C]
     # transpose ONCE in XLA (a fixed ~0.7ms u8 relayout), NOT per block in
     # the kernel: an in-kernel [BR, f].T benched ~35x slower over a full
     # pass on v5e — Mosaic lowers the small-tile transpose to lane/sublane
     # shuffles that dominate the whole kernel (measured 128ms vs 3.7ms at
     # 1M x 28 x 255, scripts/tpu_perf_suite.py round 4)
     comb_t = comb[:, :f].T                                        # [f, C] u8
+    if f_pad > f:
+        # padded features histogram real rows at bin 0 of their own lane
+        # slot, which finish_hist's [:f] slice drops
+        comb_t = jnp.pad(comb_t, ((0, f_pad - f), (0, 0)))
 
     # The WHOLE [num_slots, 6, f*Bp] accumulator rides one constant-index
     # output block: it stays VMEM-resident across the entire grid (k=16
@@ -228,14 +277,11 @@ def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
         def _init():
             out_ref[:] = jnp.zeros_like(out_ref)
 
-        b = bins_ref[:].astype(jnp.int32)                         # [f, BR]
-        bin_id = jax.lax.broadcasted_iota(jnp.int32, (f, Bp, BR), 1)
-        onehot = (b[:, None, :] == bin_id).astype(jnp.bfloat16)
-        onehot = onehot.reshape(f * Bp, BR)
-        acc = jax.lax.dot_general(
-            gh_ref[:], onehot,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)                   # [6, f*Bp]
+        # the one-hot build + dot live in the variant registry
+        # (ops/onehot_variants.py) — ONE set of kernel bodies shared with
+        # _hist_pallas and the shootout
+        acc = spec.contrib(bins_ref[:], gh_ref[:],
+                           fc=f_pad, B=B, Bp=Bp, BR=BR)           # [6, lanes]
         slot_id = jax.lax.broadcasted_iota(jnp.int32, (num_slots, 1, 1), 0)
         # where, not sel*acc: 0.0 * inf would leak one bad block's NaNs
         # into every slot's histogram instead of only its own
@@ -244,19 +290,18 @@ def _hist_leaves_pallas(comb, grad, hess, mask, block_leaf, num_slots,
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nb,),
-        in_specs=[pl.BlockSpec((f, BR), lambda i, bl: (0, i)),
-                  pl.BlockSpec((6, BR), lambda i, bl: (0, i))],
-        out_specs=pl.BlockSpec((num_slots, 6, f * Bp),
+        in_specs=[pl.BlockSpec((f_pad, BR), lambda i, bl: (0, i)),
+                  pl.BlockSpec((rows.shape[0], BR), lambda i, bl: (0, i))],
+        out_specs=pl.BlockSpec((num_slots, 6, lanes),
                                lambda i, bl: (0, 0, 0)),
     )
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((num_slots, 6, f * Bp), jnp.float32),
-    )(block_leaf.astype(jnp.int32), comb_t, gh6)
+        out_shape=jax.ShapeDtypeStruct((num_slots, 6, lanes), jnp.float32),
+        interpret=interpret,
+    )(block_leaf.astype(jnp.int32), comb_t, rows)
 
-    out = out.reshape(num_slots, 2, 3, f, Bp)
-    hist = out[:, 0] + out[:, 1]                                  # hi + lo
-    return hist[:, :, :, :B].transpose(0, 2, 3, 1)                # [k, f, B, 3]
+    return finish_hist(out, f, B, Bp, spec)                   # [k, f, B, 3]
 
 
 def unrolled_rank(sorted_vals: jax.Array, targets: jax.Array,
@@ -303,7 +348,8 @@ _PALLAS_LEAFACC_BYTES = 48 * 1024 * 1024
 
 
 def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,
-                 f_limit=None, layout="featmajor"):
+                 f_limit=None, layout="featmajor", variant="base",
+                 interpret=None):
     """Fused histogram: Pallas TPU kernel, bf16 split-precision one-hot matmul.
 
     TPUs have no fast scatter atomics, so the scatter-add is a one-hot matmul
@@ -332,16 +378,32 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,
     ``scripts/tpu_perf_suite.py``), so it is opt-in for benchmarking
     only, never picked automatically.
 
+    The one-hot build + dot bodies live in the variant registry
+    (``ops/onehot_variants.py``) — ``variant`` selects the build strategy
+    (lane packing, staged compare, int8 MXU, ...); this function owns only
+    the grid/BlockSpec shells and the fixed layout lessons above.
+
     This replaces the reference's CPU hot loop (``dense_bin.hpp:97-142``) and
     its per-workgroup local-memory GPU kernels
     (``src/treelearner/ocl/histogram256.cl:100``).
     """
     from jax.experimental import pallas as pl
 
+    from .onehot_variants import VARIANTS, finish_hist
+
+    spec = VARIANTS[variant]
     n, f_cols = bins.shape
     f = min(f_limit, f_cols) if f_limit is not None else f_cols
     B = max_bin
     Bp = -(-B // 128) * 128                      # lane-tile aligned bin width
+    if not spec.supports(B):
+        raise ValueError(
+            f"hist variant {variant!r} does not support max_bin={B} "
+            "(resolve the variant with onehot_variants.resolve first)")
+    gf = spec.group_feats(B, Bp)                 # features per lane group
+    lpf = spec.group_lanes(B, Bp) // gf          # output lanes per feature
+    if interpret is None:
+        interpret = _pallas_interpret_default()
 
     if layout not in ("featmajor", "rowmajor"):
         raise ValueError(f"unknown histogram layout {layout!r}")
@@ -350,20 +412,25 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,
             f"layout='rowmajor' needs f*Bp <= {_PALLAS_ROWMAJOR_MAX_LANES} "
             f"lanes (got {f * Bp}); the benchmark comparison would silently "
             "run the featmajor kernel instead")
-    gh6 = _gh6(grad, hess, mask)                                  # [6, N] bf16
+    rows = spec.prep(grad, hess, mask)           # [R, N]: bf16 pair or f32
 
     if layout == "rowmajor":
         # ---- row-major path: one feature block spans all features ----------
+        if f % gf:
+            raise ValueError(
+                f"layout='rowmajor' with variant {variant!r} needs the "
+                f"feature count to be a multiple of {gf} (got {f})")
         f_pad = f
+        lanes = f_pad * lpf
         # BR is the bins block's sublane dim AND the gh block's lane dim, so
         # it must be a 128-multiple
-        br_cap = max(128, (_PALLAS_ONEHOT_BYTES // (2 * f_pad * Bp)) // 128 * 128)
+        br_cap = max(128, (_PALLAS_ONEHOT_BYTES // (2 * f_pad * lpf)) // 128 * 128)
         BR = max(128, min(block_rows or _PALLAS_BLOCK_ROWS, br_cap,
                           -(-n // 128) * 128))
         pad = (-n) % BR
         if pad:
             bins = jnp.pad(bins, ((0, pad), (0, 0)))
-            gh6 = jnp.pad(gh6, ((0, 0), (0, pad)))
+            rows = jnp.pad(rows, ((0, 0), (0, pad)))
             # padded rows carry zero weight in every channel
         n_rb = (n + pad) // BR
 
@@ -379,37 +446,37 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,
             # per-step relayout that benched ~10x slower.  Trailing f_limit
             # columns (packed gradient bytes) are dropped by the sublane
             # slice after the transpose.
-            b = bins_ref[:].astype(jnp.int32).T[:f_pad]       # [f_pad, BR]
-            bin_id = jax.lax.broadcasted_iota(jnp.int32, (f_pad, Bp, BR), 1)
-            onehot = (b[:, None, :] == bin_id).astype(jnp.bfloat16)
-            onehot = onehot.reshape(f_pad * Bp, BR)
-            out_ref[:] += jax.lax.dot_general(
-                gh_ref[:], onehot,
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)           # [6, f_pad*Bp]
+            b = bins_ref[:].T[:f_pad]                         # [f_pad, BR]
+            out_ref[:] += spec.contrib(b, gh_ref[:],
+                                       fc=f_pad, B=B, Bp=Bp, BR=BR)
 
         out = pl.pallas_call(
             kernel_rm,
-            out_shape=jax.ShapeDtypeStruct((6, f_pad * Bp), jnp.float32),
+            out_shape=jax.ShapeDtypeStruct((6, lanes), jnp.float32),
             grid=(n_rb,),
             in_specs=[pl.BlockSpec((BR, bins.shape[1]), lambda i: (i, 0)),
-                      pl.BlockSpec((6, BR), lambda i: (0, i))],
-            out_specs=pl.BlockSpec((6, f_pad * Bp), lambda i: (0, 0)),
-        )(bins, gh6)
+                      pl.BlockSpec((rows.shape[0], BR), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((6, lanes), lambda i: (0, 0)),
+            interpret=interpret,
+        )(bins, rows)
     else:
         # ---- feature-major blocked path (wide features) --------------------
         if f < f_cols:
             bins = bins[:, :f]                   # drop packed-gradient cols
-        FC = max(8, _PALLAS_BLOCK_LANES // Bp)   # features per block (8-mult)
+        # features per block: 8-sublane floor, lane-pack group multiple
+        align = max(8, gf)
+        FC = max(align, (_PALLAS_BLOCK_LANES // lpf) // align * align)
         n_fb = -(-f // FC)
         f_pad = n_fb * FC
-        # bound the VMEM-resident one-hot tile: FC*Bp*BR bf16 <= budget
-        br_cap = max(128, (_PALLAS_ONEHOT_BYTES // (2 * FC * Bp)) // 128 * 128)
+        lanes = FC * lpf                         # output lanes per block
+        # bound the VMEM-resident one-hot tile: FC*lpf*BR (2-byte worst
+        # case; the int8 variant's tile is half that) <= budget
+        br_cap = max(128, (_PALLAS_ONEHOT_BYTES // (2 * FC * lpf)) // 128 * 128)
         BR = max(128, min(block_rows or _PALLAS_BLOCK_ROWS, br_cap,
                           -(-n // 128) * 128))
         pad = (-n) % BR
         if pad:
-            gh6 = jnp.pad(gh6, ((0, 0), (0, pad)))
+            rows = jnp.pad(rows, ((0, 0), (0, pad)))
         bins_t = jnp.pad(bins.T, ((0, f_pad - f), (0, pad)))  # [f_pad, Npad]
         n_rb = (n + pad) // BR
 
@@ -418,27 +485,20 @@ def _hist_pallas(bins, grad, hess, mask, max_bin, block_rows=None,
             def _init():
                 out_ref[:] = jnp.zeros_like(out_ref)
 
-            b = bins_ref[:].astype(jnp.int32)                 # [FC, BR]
-            bin_id = jax.lax.broadcasted_iota(jnp.int32, (FC, Bp, BR), 1)
-            onehot = (b[:, None, :] == bin_id).astype(jnp.bfloat16)
-            onehot = onehot.reshape(FC * Bp, BR)
-            out_ref[:] += jax.lax.dot_general(
-                gh_ref[:], onehot,
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)           # [6, FC*Bp]
+            out_ref[:] += spec.contrib(bins_ref[:], gh_ref[:],
+                                       fc=FC, B=B, Bp=Bp, BR=BR)
 
         out = pl.pallas_call(
             kernel_fm,
-            out_shape=jax.ShapeDtypeStruct((6, f_pad * Bp), jnp.float32),
+            out_shape=jax.ShapeDtypeStruct((6, n_fb * lanes), jnp.float32),
             grid=(n_fb, n_rb),
             in_specs=[pl.BlockSpec((FC, BR), lambda fb, i: (fb, i)),
-                      pl.BlockSpec((6, BR), lambda fb, i: (0, i))],
-            out_specs=pl.BlockSpec((6, FC * Bp), lambda fb, i: (0, fb)),
-        )(bins_t, gh6)
+                      pl.BlockSpec((rows.shape[0], BR), lambda fb, i: (0, i))],
+            out_specs=pl.BlockSpec((6, lanes), lambda fb, i: (0, fb)),
+            interpret=interpret,
+        )(bins_t, rows)
 
-    out = out.reshape(2, 3, f_pad, Bp)
-    hist = out[0] + out[1]                                    # hi + lo parts
-    return hist[:, :f, :B].transpose(1, 2, 0)
+    return finish_hist(out, f, B, Bp, spec)
 
 
 def gather_rows(bins: jax.Array, grad: jax.Array, hess: jax.Array,

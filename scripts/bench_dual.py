@@ -64,16 +64,10 @@ def run_checks(emit) -> int:
 
     rc = 0
 
-    # Parity threshold: the Pallas kernels accumulate a bf16 (hi, lo)
-    # split-precision pair, whose lo-residual rounding is ~2^-18 per row;
-    # summed over ~N/B rows per bin this measures 1.2e-4 at 200k rows on
-    # v5e (scripts/debug_bf16_fence2.py).  5e-4 gives shape headroom while
-    # still rejecting bare-bf16 accumulation by >200x (the lo-collapse bug
-    # class measures ~1e-1 against a true-f32 reference).  The reference
-    # MUST be true f32: _hist_onehot pins precision=HIGHEST internally —
-    # at DEFAULT TPU matmul precision it is itself bf16-grade (relerr 0.13
-    # vs the exact scatter-add), which once masked that very bug.
-    TOL = 5e-4
+    # Parity threshold: the shared lo-residual-floor constant from
+    # ops/histogram.py (its derivation lives on the constant) — ONE number
+    # for every kernel parity gate, hardware or interpret.
+    from lightgbm_tpu.ops.histogram import HIST_PARITY_TOL as TOL
 
     # 1/2: one-hot kernel, both layouts (rowmajor is bench-opt-in but must
     # stay numerically correct while it exists)

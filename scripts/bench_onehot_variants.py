@@ -2,22 +2,27 @@
 
 The production kernel (ops/histogram.py:_hist_pallas) is VPU-bound building
 the one-hot (iota-compare-select over f*Bp*BR elements per block; measured
-~12% MFU at the bench shape).  Each variant here changes ONE aspect of the
-one-hot build so the winner can be folded back into the production kernel:
+~12% MFU at the bench shape).  Every candidate build lives in the SHARED
+variant registry (lightgbm_tpu/ops/onehot_variants.py) — the same kernel
+bodies the production kernels run — so the shootout prices exactly what
+training would ship and nothing can drift between the two (the pre-registry
+shootout duplicated kernel code by hand).
 
-  base      int32 iota compare -> bf16 select (current production shape)
-  bf16cmp   bf16 iota + bf16 bins compare (2-byte lanes may pack 2x)
-  i16cmp    int16 iota + int16 bins compare
-  sub1abs   onehot = max(0, 1 - |b - j|) in bf16 (no select, all-arith)
-  brN       base at BR in {256, 1024, 2048} (VMEM one-hot budget sweep)
-
-Every variant is parity-checked against the XLA one-hot before timing.
-Results append to perf_results.jsonl (stage "onehot_variant").
+Per (variant, BR, max_bin) entry: parity vs the true-f32 XLA one-hot at the
+shared tolerance (HIST_PARITY_TOL), then a 10-iteration timing.  Results
+append to perf_results.jsonl (stage "onehot_variant") with the structural
+work model alongside the wall-clock: ``mxu_lanes`` (the dot's N-dim) and
+``onehot_elems_per_row`` (VPU compare count) — see docs/PERF.md "ceiling
+attack" for how to read them.
 
 Run (the ONLY process touching the TPU):
-    python scripts/bench_onehot_variants.py [rows]
+    python scripts/bench_onehot_variants.py [rows] [--max-bin 255,64]
+
+``--max-bin`` takes a comma list; the default sweeps 255 (the Higgs bench
+width) and 64 (exercising the lane-packing variant).  The watcher's
+onehot_shootout stage runs this unchanged.
 """
-import functools
+import argparse
 import json
 import os
 import sys
@@ -30,7 +35,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 OUT = os.environ.get("WATCHER_PERF_LOG") or os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "perf_results.jsonl")
-ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
 
 
 def emit(**kv):
@@ -40,84 +44,106 @@ def emit(**kv):
     print(json.dumps(kv), flush=True)
 
 
-def make_kernel(f, Bp, BR, onehot_fn):
-    """Feature-major single-block kernel (bins pre-transposed OUTSIDE —
-    the production layout; the in-kernel transpose benched 35x slower) with
-    a pluggable one-hot builder."""
+# (variant, BR) grid: every registry family at the production BR, plus a
+# BR sweep for the families whose VMEM one-hot budget trade-off moved the
+# needle in earlier rounds
+def entry_grid(variant_names):
+    entries = [(name, 512) for name in variant_names]
+    entries += [("base", 256), ("base", 1024), ("base", 2048),
+                ("u8cmp", 1024), ("u8cmp", 2048),
+                ("staged", 1024), ("packed", 1024), ("int8", 1024)]
+    return entries
+
+
+def run_shootout(rows, max_bins, emit=emit, interpret=False):
+    """All (variant, BR) entries at each requested max_bin; importable so
+    the perf suite / tests can drive the same sweep in-process."""
     import jax
     import jax.numpy as jnp
-    from jax.experimental import pallas as pl
+    import numpy as np
 
-    def kernel(bins_ref, gh_ref, out_ref):
-        @pl.when(pl.program_id(0) == 0)
-        def _init():
-            out_ref[:] = jnp.zeros_like(out_ref)
+    import bench
+    from lightgbm_tpu.ops import onehot_variants as ov
+    from lightgbm_tpu.ops.histogram import HIST_PARITY_TOL, _hist_onehot
 
-        b = bins_ref[:]                                       # [f, BR] u8
-        onehot = onehot_fn(b, f, Bp, BR).reshape(f * Bp, BR)
-        out_ref[:] += jax.lax.dot_general(
-            gh_ref[:], onehot,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    F = 28
+    peak = bench._PEAK_BF16_FLOPS.get(
+        jax.devices()[0].device_kind.lower(), 197e12)
+    # Per-entry failures (parity or lowering) are fully recorded as their
+    # own ok:false jsonl entries and must NOT fail the stage: a nonzero
+    # exit would make the watcher mark the whole onehot_shootout stage
+    # failed — and re-run the entire 60-min sweep under stage retries —
+    # because ONE experimental variant refused to lower, discarding every
+    # valid timing already captured.  Nonzero is reserved for the sweep
+    # itself crashing (main's probe abort / an unhandled error).
+    for B in max_bins:
+        rng = np.random.default_rng(0)
+        # pad rows to a multiple of the largest BR so every entry divides
+        N = -(-rows // 2048) * 2048
+        bins = rng.integers(0, B, size=(N, F), dtype=np.uint8)
+        g_np = rng.normal(size=N).astype(np.float32)
+        g_np[rows:] = 0.0
+        g = jnp.asarray(g_np)
+        h = jnp.asarray(np.full(N, 0.25, np.float32))
+        m = jnp.asarray((np.arange(N) < rows).astype(np.float32))
+        bins_t = jnp.asarray(np.ascontiguousarray(bins.T))  # [F, N] u8, once
+        bins_d = jnp.asarray(bins)
 
-    def run(bins_t, gh6):
-        n = bins_t.shape[1]
-        assert n % BR == 0
-        return pl.pallas_call(
-            kernel,
-            out_shape=jax.ShapeDtypeStruct((6, f * Bp), jnp.float32),
-            grid=(n // BR,),
-            in_specs=[pl.BlockSpec((f, BR), lambda i: (0, i)),
-                      pl.BlockSpec((6, BR), lambda i: (0, i))],
-            out_specs=pl.BlockSpec((6, f * Bp), lambda i: (0, 0)),
-            interpret=bool(os.environ.get("ONEHOT_INTERPRET")),
-        )(bins_t, gh6)
-    return run
+        ref = jax.jit(lambda b_, g_: _hist_onehot(b_, g_, h, m, B, 65536))(
+            bins_d, g)
+        ref = ref.block_until_ready()
+
+        for name, BR in entry_grid(ov.VARIANT_NAMES):
+            spec = ov.VARIANTS[name]
+            tag = f"{name}_br{BR}"
+            if not spec.supports(B):
+                emit(stage="onehot_variant", name=tag, max_bin=B,
+                     skipped="unsupported_max_bin")
+                continue
+            try:
+                prep, run = ov.make_bench_kernel(name, F, B, BR,
+                                                 interpret=interpret)
+                rows_arr = jax.jit(prep)(g, h, m).block_until_ready()
+                jfn = jax.jit(run)
+                hist = jfn(bins_t, rows_arr).block_until_ready()
+                err = float(jnp.max(jnp.abs(hist - ref)
+                                    / (jnp.abs(ref) + 1.0)))
+                if err > HIST_PARITY_TOL:
+                    emit(stage="onehot_variant", name=tag, max_bin=B,
+                         ok=False, relerr=err)
+                    continue
+                t0 = time.perf_counter()
+                for _ in range(10):
+                    r = jfn(bins_t, rows_arr)
+                r.block_until_ready()
+                dt = (time.perf_counter() - t0) / 10
+                lanes = ov.total_lanes(name, F, B)
+                emit(stage="onehot_variant", name=tag, variant=name, br=BR,
+                     max_bin=B, ok=True, relerr=err,
+                     ms=round(dt * 1e3, 3),
+                     # useful-FLOPs MFU vs the bf16 peak: 2 * 6 rows * N *
+                     # the dot's actual N-dim (lane packing SHRINKS it)
+                     mfu=round(2.0 * 6 * rows * lanes / dt / peak, 4),
+                     mxu_lanes=lanes,
+                     onehot_elems_per_row=spec.vpu_compares(F, B, 1))
+            except Exception as e:
+                emit(stage="onehot_variant", name=tag, max_bin=B, ok=False,
+                     error=str(e)[:250])
+    return 0
 
 
-def onehot_base(b, f, Bp, BR):
-    import jax
-    import jax.numpy as jnp
-    bi = b.astype(jnp.int32)
-    bin_id = jax.lax.broadcasted_iota(jnp.int32, (f, Bp, BR), 1)
-    return (bi[:, None, :] == bin_id).astype(jnp.bfloat16)
+def parse_args(argv):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("rows", nargs="?", type=int, default=1_000_000)
+    ap.add_argument("--max-bin", default="255,64",
+                    help="comma list of histogram widths to sweep")
+    return ap.parse_args(argv)
 
 
-def onehot_bf16cmp(b, f, Bp, BR):
-    import jax
-    import jax.numpy as jnp
-    bb = b.astype(jnp.bfloat16)                  # bins < 256: exact in bf16
-    bin_id = jax.lax.broadcasted_iota(jnp.bfloat16, (f, Bp, BR), 1)
-    return (bb[:, None, :] == bin_id).astype(jnp.bfloat16)
+def main(argv=None):
+    args = parse_args(argv)
+    max_bins = [int(b) for b in str(args.max_bin).split(",") if b.strip()]
 
-
-def onehot_i16cmp(b, f, Bp, BR):
-    import jax
-    import jax.numpy as jnp
-    bi = b.astype(jnp.int16)
-    bin_id = jax.lax.broadcasted_iota(jnp.int16, (f, Bp, BR), 1)
-    return (bi[:, None, :] == bin_id).astype(jnp.bfloat16)
-
-
-def onehot_u8cmp(b, f, Bp, BR):
-    # 1-byte compare domain (VERDICT r4 item 2: "u8-domain compares upcast
-    # in the dot"): u8 lanes pack 4x vs i32, and Bp=256 exactly spans u8
-    import jax
-    import jax.numpy as jnp
-    bin_id = jax.lax.broadcasted_iota(jnp.uint8, (f, Bp, BR), 1)
-    return (b[:, None, :] == bin_id).astype(jnp.bfloat16)
-
-
-def onehot_sub1abs(b, f, Bp, BR):
-    import jax
-    import jax.numpy as jnp
-    bb = b.astype(jnp.bfloat16)
-    bin_id = jax.lax.broadcasted_iota(jnp.bfloat16, (f, Bp, BR), 1)
-    d = bb[:, None, :] - bin_id
-    return jnp.maximum(jnp.bfloat16(1.0) - jnp.abs(d), jnp.bfloat16(0.0))
-
-
-def main():
     import bench
     if "axon" in os.environ.get("JAX_PLATFORMS", "axon") \
             and not os.environ.get("BENCH_SKIP_PROBE") \
@@ -126,62 +152,8 @@ def main():
         emit(stage="abort", reason="tpu_unreachable")
         return 1
 
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from lightgbm_tpu.ops.histogram import _hist_onehot
-
-    N, F, B = ROWS, 28, 255
-    Bp = 256
-    rng = np.random.default_rng(0)
-    bins = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
-    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
-    h = jnp.asarray(np.full(N, 0.25, np.float32))
-    m = jnp.ones(N, jnp.float32)
-    from lightgbm_tpu.ops.histogram import _gh6
-    gh6 = _gh6(g, h, m)                     # fenced split-precision pair
-    bins_t = jnp.asarray(np.ascontiguousarray(
-        np.asarray(bins).T))                # [F, N] u8, transposed ONCE
-
-    ref = jax.jit(lambda b_, g_: _hist_onehot(b_, g_, h, m, B, 65536))(bins, g)
-    ref = ref.block_until_ready()
-
-    peak = bench._PEAK_BF16_FLOPS.get(
-        jax.devices()[0].device_kind.lower(), 197e12)
-    variants = [("base_br512", onehot_base, 512),
-                ("bf16cmp_br512", onehot_bf16cmp, 512),
-                ("i16cmp_br512", onehot_i16cmp, 512),
-                ("u8cmp_br512", onehot_u8cmp, 512),
-                ("sub1abs_br512", onehot_sub1abs, 512),
-                ("base_br256", onehot_base, 256),
-                ("base_br1024", onehot_base, 1024),
-                ("base_br2048", onehot_base, 2048),
-                ("u8cmp_br1024", onehot_u8cmp, 1024),
-                ("u8cmp_br2048", onehot_u8cmp, 2048)]
-    for name, fn, BR in variants:
-        try:
-            run = make_kernel(F, Bp, BR, fn)
-            jfn = jax.jit(run)
-            out = jfn(bins_t, gh6).block_until_ready()
-            hist = (out.reshape(2, 3, F, Bp)[0]
-                    + out.reshape(2, 3, F, Bp)[1])[:, :, :B].transpose(1, 2, 0)
-            # same tolerance derivation as scripts/bench_dual.py TOL
-            err = float(jnp.max(jnp.abs(hist - ref) / (jnp.abs(ref) + 1.0)))
-            if err > 5e-4:
-                emit(stage="onehot_variant", name=name, ok=False, relerr=err)
-                continue
-            t0 = time.perf_counter()
-            for _ in range(10):
-                r = jfn(bins_t, gh6)
-            r.block_until_ready()
-            dt = (time.perf_counter() - t0) / 10
-            emit(stage="onehot_variant", name=name, ok=True,
-                 ms=round(dt * 1e3, 3),
-                 mfu=round(2.0 * 6 * N * F * Bp / dt / peak, 4))
-        except Exception as e:
-            emit(stage="onehot_variant", name=name, ok=False,
-                 error=str(e)[:250])
-    return 0
+    return run_shootout(args.rows, max_bins,
+                        interpret=bool(os.environ.get("ONEHOT_INTERPRET")))
 
 
 if __name__ == "__main__":

@@ -162,16 +162,43 @@ def phase_hist_micro(ctx):
         return timed_jfn(jfn, lambda eps: (bins, g + eps), iters)
 
     if jax.default_backend() == "tpu":
+        peak = bench._PEAK_BF16_FLOPS.get(
+            jax.devices()[0].device_kind.lower(), 197e12)
         try:
             t_pallas = timed(_hist_pallas)
             Bp = -(-B // 128) * 128
-            peak = bench._PEAK_BF16_FLOPS.get(
-                jax.devices()[0].device_kind.lower(), 197e12)
             emit(stage="hist_pallas", ms=round(t_pallas * 1e3, 3),
                  grows_per_sec=round(N / t_pallas / 1e9, 3),
                  mfu=round(2.0 * 6 * N * F * Bp / t_pallas / peak, 4))
         except Exception as e:        # lowering failure must be visible
             emit(stage="hist_pallas", error=str(e)[:300])
+        # production-kernel variant sweep from the SHARED registry
+        # (ops/onehot_variants.py) at the bench width AND max_bin=64 (the
+        # lane-packing width): these numbers price exactly what
+        # hist_variant=<name> would ship, because _hist_pallas and the
+        # shootout run the same registry bodies.  The full (variant, BR)
+        # grid lives in scripts/bench_onehot_variants.py (the watcher's
+        # onehot_shootout stage sweeps --max-bin the same way).
+        from lightgbm_tpu.ops import onehot_variants as ov
+        rng_v = np.random.default_rng(1)
+        for vb in (B, 64):
+            vbins = bins if vb == B else jnp.asarray(
+                rng_v.integers(0, vb, size=(N, F), dtype=np.uint8))
+            for vname in ov.AUTO_CANDIDATES:
+                if not ov.VARIANTS[vname].supports(vb):
+                    continue
+                try:
+                    jv = jax.jit(lambda b_, g_, v=vname, bb=vb: jnp.sum(
+                        _hist_pallas(b_, g_, h, m, bb, variant=v)))
+                    t_v = timed_jfn(jv, lambda eps: (vbins, g + eps))
+                    lanes = ov.total_lanes(vname, F, vb)
+                    emit(stage="hist_pallas_variant", variant=vname,
+                         max_bin=vb, ms=round(t_v * 1e3, 3),
+                         mxu_lanes=lanes,
+                         mfu=round(2.0 * 6 * N * lanes / t_v / peak, 4))
+                except Exception as e:
+                    emit(stage="hist_pallas_variant", variant=vname,
+                         max_bin=vb, error=str(e)[:250])
         # batched-leaf kernel at the frontier shape: same rows split over
         # 16 slots in 512-row blocks (the per-round frontier workload)
         try:

@@ -207,8 +207,13 @@ def stage_table(args) -> list:
         ("perf_suite", [py, os.path.join(REPO, "scripts",
                                          "tpu_perf_suite.py")],
          t["perf_suite"], {"BENCH_SKIP_PROBE": "1"}),
+        # the shootout sweeps every registry variant family at the bench
+        # width AND max_bin=64 (exercising the lane-packing variant); the
+        # flag mirrors the script default so the sweep is explicit in the
+        # journal's argv without changing watcher_state.json semantics
         ("onehot_shootout", [py, os.path.join(REPO, "scripts",
-                                              "bench_onehot_variants.py")],
+                                              "bench_onehot_variants.py"),
+                             "--max-bin", "255,64"],
          t["onehot_shootout"], {"BENCH_SKIP_PROBE": "1"}),
         ("headline", [py, os.path.join(REPO, "bench.py")],
          t["headline"], {"BENCH_SKIP_PROBE": "1"}),

@@ -125,6 +125,55 @@ def test_pickle_roundtrip(binary_data):
     m2 = pickle.loads(blob)
     pred_after = m2.predict_proba(Xte)
     np.testing.assert_allclose(pred_before, pred_after, rtol=1e-6)
+    # the unpickled estimator is a full citizen: params survive, and it can
+    # keep working (predict classes, re-fit) without touching the original
+    assert m2.get_params() == m.get_params()
+    assert (m2.predict(Xte) == m.predict(Xte)).all()
+    m2.fit(Xtr, ytr)
+    assert m2.score(Xte, yte) > 0.7
+
+
+def test_pickle_roundtrip_regressor(regression_data):
+    """Fitted-regressor pickling with predict-after-unpickle parity
+    (ROADMAP 5c: sklearn conformance depth)."""
+    Xtr, ytr, Xte, yte = regression_data
+    m = LGBMRegressor(n_estimators=10, num_leaves=15, learning_rate=0.1)
+    m.fit(Xtr, ytr)
+    pred_before = m.predict(Xte)
+    m2 = pickle.loads(pickle.dumps(m))
+    np.testing.assert_allclose(m2.predict(Xte), pred_before, rtol=1e-6)
+    assert m2.get_params() == m.get_params()
+    assert m2.best_iteration_ == m.best_iteration_
+    np.testing.assert_allclose(m2.feature_importances_,
+                               m.feature_importances_)
+
+
+def test_clone_fitted_estimators(binary_data, regression_data):
+    """sklearn.base.clone on a FITTED model: the clone is an unfitted
+    estimator with identical params (so CV/grid-search machinery can copy
+    mid-pipeline models), and fitting the clone reproduces the original's
+    predictions on identical data."""
+    from sklearn.base import clone
+
+    for m, (Xtr, ytr, Xte, _) in (
+            (LGBMClassifier(n_estimators=10, num_leaves=15, reg_alpha=0.1),
+             binary_data),
+            (LGBMRegressor(n_estimators=10, num_leaves=15, reg_alpha=0.1),
+             regression_data)):
+        m.fit(Xtr, ytr)
+        c = clone(m)
+        assert c is not m
+        assert c.get_params() == m.get_params()
+        with pytest.raises(lgb.LightGBMError):
+            c.predict(Xte)                     # the clone starts unfitted
+        c.fit(Xtr, ytr)
+        if isinstance(m, LGBMClassifier):
+            np.testing.assert_allclose(c.predict_proba(Xte),
+                                       m.predict_proba(Xte), rtol=1e-5,
+                                       atol=1e-7)
+        else:
+            np.testing.assert_allclose(c.predict(Xte), m.predict(Xte),
+                                       rtol=1e-5, atol=1e-6)
 
 
 def test_class_weight(binary_data):
