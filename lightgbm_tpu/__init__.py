@@ -26,6 +26,10 @@ def __getattr__(name):
     if name in ("LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"):
         from . import sklearn as _sk
         return getattr(_sk, name)
+    if name in ("serve", "PredictorArtifact", "Predictor", "MicroBatcher",
+                "QueueSaturatedError"):
+        from . import serve as _serve
+        return _serve if name == "serve" else getattr(_serve, name)
     if name.startswith("plot_") or name in ("create_tree_digraph", "plotting"):
         import importlib
         _pl = importlib.import_module(".plotting", __name__)

@@ -139,6 +139,10 @@ _OBJECTIVE_ALIASES = {
 }
 
 TASK_TYPES = ("train", "predict", "convert_model", "refit")
+
+# canonical serving bucket defaults (the serve subsystem and bench_serve
+# source this ONE definition; retune here after hardware measurements)
+SERVE_DEFAULT_BUCKETS = (1024, 16384, 262144)
 BOOSTING_TYPES = ("gbdt", "rf", "dart", "goss")
 TREE_LEARNER_TYPES = ("serial", "feature", "data", "voting")
 DEVICE_TYPES = ("cpu", "gpu", "cuda", "tpu")
@@ -325,6 +329,15 @@ class Config:
     frontier_block_rows: int = 512            # kernel rows/block (128-mult)
     mesh_shape: List[int] = field(default_factory=list)   # device mesh, [] = all devices on one axis
     pred_device: str = "auto"                 # auto | device | host ensemble predict
+    # serving subsystem (lightgbm_tpu/serve, docs/SERVING.md): batch-shape
+    # buckets the PredictorArtifact AOT-compiles (requests pad to the
+    # nearest bucket; larger requests chunk by the biggest one)
+    serve_buckets: List[int] = field(
+        default_factory=lambda: list(SERVE_DEFAULT_BUCKETS))
+    # micro-batcher: how long the first request of a batch waits for
+    # company, and how many requests may queue before load is shed
+    serve_batch_deadline_ms: float = 2.0
+    serve_queue_depth: int = 64
 
     # unknown keys seen during parsing (kept for model-file round trip)
     _unknown: Dict[str, Any] = field(default_factory=dict, repr=False)
@@ -426,6 +439,16 @@ class Config:
             raise LightGBMError(
                 f"hist_variant must be auto or one of "
                 f"{'/'.join(VARIANT_NAMES)}, got '{self.hist_variant}'")
+
+        self.serve_buckets = sorted({int(b) for b in self.serve_buckets})
+        if not self.serve_buckets or self.serve_buckets[0] < 1:
+            raise LightGBMError(
+                "serve_buckets must be a non-empty list of positive row "
+                "counts")
+        if self.serve_batch_deadline_ms < 0:
+            raise LightGBMError("serve_batch_deadline_ms must be >= 0")
+        if self.serve_queue_depth < 1:
+            raise LightGBMError("serve_queue_depth must be >= 1")
 
         self.tree_grower = self.tree_grower.lower()
         if self.tree_grower not in ("auto", "serial", "frontier"):

@@ -1148,6 +1148,9 @@ class GBDT:
                     # text-loaded trees carry VALUE bitsets only; binned
                     # traversal needs the bin-space ones
                     t.bin_cat_bitsets(self.train_data.bin_mappers)
+                # ... and VALUE thresholds only: without this, a file-based
+                # init_model warmed the scores with all-zero bin thresholds
+                t.bin_numeric_thresholds(self.train_data.bin_mappers)
             for i, t in enumerate(self.models):
                 if getattr(t, "is_linear", False):
                     # linear leaves need raw values (binned midpoints would

@@ -50,6 +50,9 @@ class Tree:
         # BIN-space bitsets per cat node (for binned traversal); rebuilt from
         # the value bitsets via bin_cat_bitsets() for text-loaded models
         self.cat_bits_bin: dict = {}
+        # text-loaded trees carry VALUE thresholds only; binned traversal
+        # must rebuild threshold_bin first (bin_numeric_thresholds)
+        self._has_bin_thresholds: bool = True
         self.shrinkage: float = 1.0
         # linear trees (reference tree.h:49-54): per-leaf linear models
         self.is_linear: bool = False
@@ -198,6 +201,29 @@ class Tree:
                         (int(words_vals[v // 32]) >> (v % 32)) & 1:
                     out[bi // 32] |= np.uint32(1 << (bi % 32))
             self.cat_bits_bin[j] = out
+
+    def bin_numeric_thresholds(self, mappers) -> None:
+        """Rebuild BIN-space numeric thresholds from the value thresholds
+        so binned traversal works for text-loaded models (the numeric
+        analog of ``bin_cat_bitsets``; ``from_text`` leaves
+        ``threshold_bin`` unset because the reference grammar stores only
+        real values).  Exact for same-data continuation: model thresholds
+        are bin upper bounds, and ``value_to_bin`` maps a bound back to
+        its own bin."""
+        if self._has_bin_thresholds:
+            return
+        by_feat: dict = {}
+        for j in range(self.num_internal):
+            if not self.is_categorical_split(j):
+                by_feat.setdefault(int(self.split_feature[j]), []).append(j)
+        for fi, nodes in by_feat.items():
+            # one vectorized call per feature, not one per node: a warm
+            # start from a big ensemble rebuilds ~leaves x trees thresholds
+            bins = np.asarray(mappers[fi].value_to_bin(
+                np.array([float(self.threshold[j]) for j in nodes])))
+            for j, b in zip(nodes, bins):
+                self.threshold_bin[j] = int(b)
+        self._has_bin_thresholds = True
 
     def predict_binned(self, bins: np.ndarray, nan_bins: np.ndarray) -> np.ndarray:
         """Batch prediction over BINNED columns (inner feature space), using
@@ -376,6 +402,9 @@ class Tree:
             return np.array([dtype(x) for x in kv[name].split()])
         t.split_feature = get("split_feature", int).astype(np.int32)
         t.split_feature_inner = t.split_feature.copy()
+        # the grammar stores real-valued thresholds only; bin-space ones
+        # are rebuilt on demand (bin_numeric_thresholds) against a Dataset
+        t._has_bin_thresholds = False
         sg = get("split_gain", float)
         t.split_gain = sg.astype(np.float32) if sg is not None else np.zeros(nl - 1, np.float32)
         t.threshold = get("threshold", float).astype(np.float64)

@@ -191,25 +191,47 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         from .grower import rand_thresholds_for
         return rand_thresholds_for(key, step, cfg.extra_seed, _nb_r, _nanb_r)
 
-    def find(hist_fb, sum_g, sum_h, count, fmask=None, rand=None):
+    # --- monotone-basic: output bounds pinch at the midpoint down the root
+    # path (grower.py apply_split basic branch), which is per-leaf state the
+    # frontier already carries — intermediate/advanced (cross-leaf
+    # propagation) stay on the sequential grower (_frontier_eligible)
+    use_mono = cfg.has_monotone
+    use_pen = cfg.has_monotone and cfg.monotone_penalty > 0.0
+
+    def mult_for(depth):
+        if not use_pen:
+            return None
+        from .grower import monotone_gain_mult
+        return monotone_gain_mult(depth, monotone, cfg.monotone_penalty)
+
+    def find(hist_fb, sum_g, sum_h, count, fmask=None, rand=None,
+             lo=NEG_INF, hi=POS_INF, mult=None):
         fmask = feature_mask if fmask is None else fmask
         if mode == "feature":
             from .grower import _reduce_split_global
             s = find_best_split(hist_fb, num_bins_l, default_bins_l,
                                 nan_bins_l, is_cat_l, mono_l, sum_g, sum_h,
-                                count, p, lslice(fmask), rand_threshold=rand,
-                                sorted_cat=cfg.sorted_cat, contri=contri_l)
+                                count, p, lslice(fmask),
+                                output_lo=lo, output_hi=hi,
+                                rand_threshold=rand,
+                                sorted_cat=cfg.sorted_cat,
+                                gain_mult=(lslice(mult) if mult is not None
+                                           else None),
+                                contri=contri_l)
             s = s._replace(feature=s.feature + f_start)
             return _reduce_split_global(s, axis)
         if mode == "voting":
-            return _find_voting(hist_fb, sum_g, sum_h, count, fmask, rand)
+            return _find_voting(hist_fb, sum_g, sum_h, count, fmask, rand,
+                                lo, hi, mult)
         return find_best_split(hist_fb, num_bins, default_bins, nan_bins,
                                is_categorical, monotone, sum_g, sum_h, count,
-                               p, fmask, rand_threshold=rand,
-                               sorted_cat=cfg.sorted_cat,
+                               p, fmask, output_lo=lo, output_hi=hi,
+                               rand_threshold=rand,
+                               sorted_cat=cfg.sorted_cat, gain_mult=mult,
                                contri=feature_contri)
 
-    def _find_voting(hist, sum_g, sum_h, count, fmask, rand=None):
+    def _find_voting(hist, sum_g, sum_h, count, fmask, rand=None,
+                     lo=NEG_INF, hi=POS_INF, mult=None):
         """Local top-k proposal -> global vote -> reduce only elected
         histograms (the election dataflow lives once in split.voting_elect,
         shared with the sequential grower)."""
@@ -217,11 +239,14 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         hist_e, emask = voting_elect(
             hist, num_bins, nan_bins, is_categorical, monotone, sum_g,
             sum_h, count, p, fmask, axis, cfg.top_k, cfg.num_shards,
-            sorted_cat=cfg.sorted_cat, contri=feature_contri)
+            output_lo=lo, output_hi=hi,
+            sorted_cat=cfg.sorted_cat, gain_mult=mult,
+            contri=feature_contri)
         return find_best_split(hist_e, num_bins, default_bins, nan_bins,
                                is_categorical, monotone, sum_g, sum_h, count,
-                               p, emask, rand_threshold=rand,
-                               sorted_cat=cfg.sorted_cat,
+                               p, emask, output_lo=lo, output_hi=hi,
+                               rand_threshold=rand,
+                               sorted_cat=cfg.sorted_cat, gain_mult=mult,
                                contri=feature_contri)
 
     # ---- degenerate: no usable features -> single-leaf tree ---------------
@@ -260,7 +285,8 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         # feature mode replicates rows, so local sums are already global
         tot = jax.lax.psum(tot, axis)
     root_split = find(expand_hist(root_hist), tot[0], tot[1], tot[2],
-                      fmask=node_mask_for(0), rand=rand_thr_for(0))
+                      fmask=node_mask_for(0), rand=rand_thr_for(0),
+                      mult=mult_for(0))
 
     # histogram blocks ladder: rungs over the per-round leaf-grouped gather
     # capacity (block-aligned); every rung a BR multiple
@@ -316,6 +342,10 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         sp_nleft=jnp.zeros(S, jnp.int32),     # raw left row count (local)
         n_applied=jnp.int32(0),
     )
+    if use_mono:
+        # per-leaf monotone output bounds (basic mode: root-path state only)
+        state["leaf_lo"] = jnp.full(LS, NEG_INF, jnp.float32)
+        state["leaf_hi"] = jnp.full(LS, POS_INF, jnp.float32)
 
     from .split import leaf_output
 
@@ -433,6 +463,25 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         leaf_il = upd(upd(st["leaf_il"], sel, jnp.ones(k, bool), valid),
                       right_slot, jnp.zeros(k, bool), valid)
 
+        extra_mono = {}
+        if use_mono:
+            # basic mode: pinch both children at the midpoint of the child
+            # outputs (grower.py apply_split, reference BasicConstraint) —
+            # depends only on the expansion's own path, so batching k
+            # expansions cannot reorder it
+            mono_sel = monotone[sel_feat]
+            lo_p, hi_p = st["leaf_lo"][sel], st["leaf_hi"][sel]
+            mid = (b.lout[sel] + b.rout[sel]) * 0.5
+            l_lo = jnp.where(mono_sel < 0, jnp.maximum(lo_p, mid), lo_p)
+            l_hi = jnp.where(mono_sel > 0, jnp.minimum(hi_p, mid), hi_p)
+            r_lo = jnp.where(mono_sel > 0, jnp.maximum(lo_p, mid), lo_p)
+            r_hi = jnp.where(mono_sel < 0, jnp.minimum(hi_p, mid), hi_p)
+            extra_mono = dict(
+                leaf_lo=upd(upd(st["leaf_lo"], sel, l_lo, valid),
+                            right_slot, r_lo, valid),
+                leaf_hi=upd(upd(st["leaf_hi"], sel, l_hi, valid),
+                            right_slot, r_hi, valid))
+
         # ---- split records ------------------------------------------------
         def rec(arr, val):
             return arr.at[jnp.where(valid, s_idx, S)].set(val, mode="drop")
@@ -515,7 +564,20 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         g2 = jnp.concatenate([b.lg[sel], b.rg[sel]])
         h2 = jnp.concatenate([b.lh[sel], b.rh[sel]])
         c2 = jnp.concatenate([b.lc[sel], b.rc[sel]])
-        if bynode or cfg.extra_trees:
+        if use_mono:
+            # bounds per child, penalty factor per child depth; the step
+            # keying rides along (node_mask_for/rand_thr_for ignore the
+            # step when their feature is off)
+            steps2 = jnp.concatenate([s_idx, s_idx]) + 1
+            lo2 = jnp.concatenate([l_lo, r_lo])
+            hi2 = jnp.concatenate([l_hi, r_hi])
+            d2 = jnp.concatenate([depth_c, depth_c])
+            s2 = jax.vmap(lambda hc, g_, h_, c_, st_, lo_, hi_, d_: find(
+                expand_hist(hc), g_, h_, c_,
+                fmask=node_mask_for(st_), rand=rand_thr_for(st_),
+                lo=lo_, hi=hi_, mult=mult_for(d_)))(
+                hist2, g2, h2, c2, steps2, lo2, hi2, d2)
+        elif bynode or cfg.extra_trees:
             # children of the expansion recorded at s_idx draw their mask /
             # random thresholds from step s_idx+1 (both siblings share it,
             # like the sequential grower's per-step draw)
@@ -546,6 +608,7 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
             leaf_weight=leaf_weight, leaf_count=leaf_count,
             leaf_cghat=leaf_cghat, leaf_cs=leaf_cs, leaf_il=leaf_il,
             pend=pend, pend_ghat=pend_ghat, hist=hist,
+            **extra_mono,
             **recs,
             n_applied=applied + v,
         )

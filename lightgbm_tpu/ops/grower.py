@@ -266,19 +266,36 @@ def rand_thresholds_for(key, step, extra_seed: int, num_bins, nan_bins):
     return jnp.floor(u * (hi + 1).astype(jnp.float32)).astype(jnp.int32)
 
 
+def monotone_gain_mult(depth, monotone, pen: float):
+    """[F] monotone-split gain penalty factor at a leaf of ``depth``
+    (reference ``ComputeMonotoneSplitGainPenalty``,
+    monotone_constraints.hpp:355-364).  ONE implementation shared by the
+    sequential grower (closure ``gain_mult_for``) and the frontier grower
+    so the two streams cannot drift."""
+    d = jnp.asarray(depth, jnp.float32)
+    factor = jnp.where(
+        pen >= d + 1.0, 1e-15,
+        jnp.where(pen <= 1.0, 1.0 - pen / jnp.exp2(d),
+                  1.0 - jnp.exp2(pen - 1.0 - d)) + 1e-15)
+    return jnp.where(monotone != 0, factor, 1.0)
+
+
 def _frontier_eligible(cfg: "GrowerConfig", n_cols: int, interaction_sets,
                        cegb_coupled, cegb_lazy, forced,
                        efb=None) -> bool:
     """True when the round-batched frontier grower (ops/frontier.py) can
-    serve this call.  Cross-leaf-coupled features (monotone bounds, CEGB
-    refunds, interaction branch masks, forced-split prefixes) depend on the
-    sequential split order and take the one-split loop; per-node RNG
-    features (feature_fraction_bynode, extra_trees) are served by the
-    frontier with a split-record-keyed stream."""
+    serve this call.  Cross-leaf-coupled features (monotone intermediate/
+    advanced bounds, CEGB refunds, interaction branch masks, forced-split
+    prefixes) depend on the sequential split order and take the one-split
+    loop; per-node RNG features (feature_fraction_bynode, extra_trees) are
+    served by the frontier with a split-record-keyed stream, and
+    monotone-BASIC is served natively: its output bounds pinch at the
+    midpoint down the root path, which is exactly the per-leaf state the
+    frontier already tracks (no cross-leaf propagation to order against)."""
     if cfg.grower_mode == "serial":
         return False
     mode = cfg.parallel_mode or ("data" if cfg.axis_name is not None else None)
-    ok = (not cfg.has_monotone
+    ok = ((not cfg.has_monotone or cfg.monotone_mode == "basic")
           and interaction_sets is None
           and cegb_coupled is None and cegb_lazy is None
           and not forced
@@ -638,13 +655,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         separately (BEFORE CEGB) via find()'s ``contri``."""
         if not (cfg.has_monotone and cfg.monotone_penalty > 0.0):
             return None
-        pen = cfg.monotone_penalty
-        d = jnp.asarray(depth, jnp.float32)
-        factor = jnp.where(
-            pen >= d + 1.0, 1e-15,
-            jnp.where(pen <= 1.0, 1.0 - pen / jnp.exp2(d),
-                      1.0 - jnp.exp2(pen - 1.0 - d)) + 1e-15)
-        return jnp.where(monotone != 0, factor, 1.0)
+        return monotone_gain_mult(depth, monotone, cfg.monotone_penalty)
 
     def find(hist, sum_g, sum_h, count, fmask, parent_output=0.0,
              lo=NEG_INF, hi=-NEG_INF, penalty=None, rand=None, mult=None):

@@ -1,0 +1,26 @@
+"""Serving subsystem: AOT-compiled ensemble predictors behind a
+micro-batching front end with hot-swap.
+
+Three layers, composable or standalone:
+
+- :mod:`.artifact` — :class:`PredictorArtifact`: a trained ensemble frozen
+  into padded device arrays with the whole raw->traverse->accumulate->
+  output-transform pipeline ahead-of-time compiled at a small set of
+  bucketed batch shapes (no per-request retracing, donated input buffers,
+  rows sharded across the device mesh).
+- :mod:`.batcher` — :class:`MicroBatcher`: a threaded request queue that
+  coalesces concurrent requests up to a deadline, pads to the nearest
+  bucket, fans results back out, and sheds load with a clear refusal when
+  saturated.
+- :mod:`.server` — :class:`Predictor`: the multi-model front end with
+  per-model routing and atomic hot-swap (stage -> parity gate -> flip,
+  rollback on failure) so a new ensemble ships with zero downtime.
+
+See docs/SERVING.md for the lifecycle and protocols.
+"""
+from .artifact import DEFAULT_BUCKETS, PredictorArtifact
+from .batcher import MicroBatcher, QueueSaturatedError
+from .server import Predictor
+
+__all__ = ["PredictorArtifact", "MicroBatcher", "Predictor",
+           "QueueSaturatedError", "DEFAULT_BUCKETS"]

@@ -1,0 +1,295 @@
+"""Frozen ensemble predictor artifacts: AOT-compiled, bucketed, shardable.
+
+The training-side device predictor (``ops/ensemble.py``) already runs the
+whole ensemble as one compiled program, but it (re)traces lazily per input
+shape — fine for a bench, wrong for serving, where the first request of a
+new shape must not pay a multi-second compile.  A :class:`PredictorArtifact`
+freezes a trained ensemble into the serving shape:
+
+- every tree flattened/padded into stacked device arrays
+  (``stack_trees``), replicated across a 1-D device mesh;
+- the full raw->traverse->accumulate->output-transform pipeline lowered and
+  compiled AHEAD OF TIME (``jax.jit(...).lower(...).compile()``) at a small
+  set of bucketed row counts, with the request buffer donated
+  (``donate_argnums``) and rows sharded over the mesh when they divide it;
+- requests padded up to the nearest bucket (padded rows are traversed but
+  row-independent, so real rows are untouched) and chunked by the largest
+  bucket, so ANY request size is served by a fixed, finite program set —
+  compile count is ``len(buckets)``, forever.
+
+Artifacts save/load through the ``model_io`` text grammar (plus one
+trailing ``serving_config:`` line the reference parser ignores), so a
+server restart rebuilds the same programs from disk without ever touching
+training code, and the files stay loadable by plain ``Booster``.
+
+Exactness: the artifact runs the SAME stacked-tree program as
+``GBDT.predict`` on its device path (``pred_device=device``) and the same
+``ObjectiveFunction.convert_output`` transform, so outputs are bit-exact
+against it (and within float32 summation order of the host per-tree loop).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..config import SERVE_DEFAULT_BUCKETS as DEFAULT_BUCKETS
+from ..models import model_io
+from ..models.gbdt import GBDT
+from ..ops.ensemble import predict_raw_ensemble, stack_trees
+from ..parallel.mesh import default_mesh
+from ..utils.log import LightGBMError, Log, check
+
+SERVE_AXIS = "serve_batch"
+
+# ONE execution lock for ALL artifacts, not per-instance: hot-swap
+# guarantees a window where in-flight requests run on the old artifact
+# while new requests (and the parity gate) hit the new one, and two
+# threads inside different Compiled.__call__s intermittently wedge the
+# CPU runtime client.  A single device serializes program launches
+# anyway, so the global lock costs no throughput.
+_EXEC_LOCK = threading.Lock()
+
+# trailing metadata line appended after the model text; the reference
+# text parser ignores trailing content (same trick as pandas_categorical)
+_SERVE_TAG = "serving_config:"
+
+
+def _serve_mesh(devices=None) -> jax.sharding.Mesh:
+    """1-D mesh over all available devices (SNIPPETS.md [3] shape): rows
+    shard along it, the ensemble replicates across it."""
+    return default_mesh(axis_name=SERVE_AXIS, devices=devices)
+
+
+def _row_sharding(mesh, rows: int) -> NamedSharding:
+    """Shard rows across the mesh when they divide it, else replicate
+    (the ``get_naive_sharding`` fallback rule)."""
+    if rows % mesh.devices.size == 0:
+        return NamedSharding(mesh, PartitionSpec(SERVE_AXIS))
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def _strip_serve_tag(text: str) -> Tuple[str, dict]:
+    """Split a saved artifact into (model_text, serving meta)."""
+    pos = text.rfind("\n" + _SERVE_TAG)
+    if pos < 0:
+        return text, {}
+    lines = text[pos + 1 + len(_SERVE_TAG):].splitlines()
+    meta = {}
+    if lines:
+        try:
+            meta = json.loads(lines[0])
+        except ValueError:
+            meta = {}
+    return text[:pos + 1], meta if isinstance(meta, dict) else {}
+
+
+class PredictorArtifact:
+    """One servable model: frozen trees + AOT-compiled bucket programs.
+
+    Build with :meth:`freeze` (from a ``Booster``/``GBDT``),
+    :meth:`from_string` (model text) or :meth:`load` (a saved artifact
+    file); then :meth:`predict` serves any row count without retracing.
+    """
+
+    def __init__(self, gbdt: GBDT, *, model_str: Optional[str] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 name: str = "default", devices=None):
+        check(gbdt.models, "cannot freeze an ensemble with no trees")
+        self.name = name
+        self._gbdt = gbdt
+        self.model_str = model_str or model_io.save_model_to_string(gbdt)
+        if buckets is None:
+            buckets = getattr(gbdt.config, "serve_buckets", None) \
+                or DEFAULT_BUCKETS
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        check(self.buckets and self.buckets[0] > 0,
+              "serve buckets must be positive row counts")
+        self.num_class = gbdt.num_tree_per_iteration
+        self.num_features = gbdt.max_feature_idx + 1
+        self.num_trees = len(gbdt.models)
+        self._objective = gbdt.objective
+        self._any_linear = any(getattr(t, "is_linear", False)
+                               for t in gbdt.models)
+        self._mesh = _serve_mesh(devices)
+        # the ensemble is replicated: every shard traverses its own rows
+        # against the full tree set
+        self._ens = jax.device_put(
+            stack_trees(gbdt.models),
+            NamedSharding(self._mesh, PartitionSpec()))
+        self._compiled: Dict[int, jax.stages.Compiled] = {}
+        self._in_shardings: Dict[int, NamedSharding] = {}
+        self.compile_count = 0
+        self._compile_all()
+
+    # ------------------------------------------------------------------
+    # construction fronts
+    @classmethod
+    def freeze(cls, model, num_iteration: int = -1, start_iteration: int = 0,
+               buckets: Optional[Sequence[int]] = None,
+               name: str = "default", devices=None) -> "PredictorArtifact":
+        """Freeze a trained ``Booster`` (or raw ``GBDT``) into an artifact.
+
+        Serializes through the model-text grammar and rebuilds from it, so
+        the in-memory artifact is ALWAYS identical to one reloaded after a
+        restart (thresholds/leaf values round-trip at %.17g, exactly)."""
+        gbdt = getattr(model, "_gbdt", model)
+        text = model_io.save_model_to_string(
+            gbdt, -1 if num_iteration is None else num_iteration,
+            start_iteration)
+        if buckets is None:
+            buckets = getattr(gbdt.config, "serve_buckets", None)
+        return cls.from_string(text, buckets=buckets, name=name,
+                               devices=devices)
+
+    @classmethod
+    def from_string(cls, text: str, *,
+                    buckets: Optional[Sequence[int]] = None,
+                    name: Optional[str] = None,
+                    devices=None) -> "PredictorArtifact":
+        model_text, meta = _strip_serve_tag(text)
+        gbdt = model_io.load_model_from_string(model_text, GBDT)
+        if buckets is None:
+            buckets = meta.get("buckets") \
+                or getattr(gbdt.config, "serve_buckets", None)
+        return cls(gbdt, model_str=model_text, buckets=buckets,
+                   name=name or meta.get("name") or "default",
+                   devices=devices)
+
+    @classmethod
+    def load(cls, path: str, *, buckets: Optional[Sequence[int]] = None,
+             name: Optional[str] = None, devices=None) -> "PredictorArtifact":
+        with open(path) as f:
+            return cls.from_string(f.read(), buckets=buckets, name=name,
+                                   devices=devices)
+
+    def save(self, path: str) -> "PredictorArtifact":
+        """Model text + one trailing ``serving_config:`` metadata line.
+        The file stays loadable by ``Booster(model_file=...)``."""
+        meta = {"name": self.name, "buckets": list(self.buckets),
+                "num_class": self.num_class,
+                "num_features": self.num_features}
+        with open(path, "w") as f:
+            f.write(self.model_str)
+            if not self.model_str.endswith("\n"):
+                f.write("\n")
+            f.write(_SERVE_TAG + json.dumps(meta) + "\n")
+        return self
+
+    # ------------------------------------------------------------------
+    # AOT compilation
+    def _pipeline(self, ens, x):
+        """raw->traverse->accumulate->transform, one program.  Returns
+        ``(raw [rows, K], transformed [rows, K])`` so one executable serves
+        both ``raw_score`` modes."""
+        raw = predict_raw_ensemble(ens, x, self.num_class, self._any_linear)
+        obj = self._objective
+        if obj is None:
+            out = raw
+        elif self.num_class > 1:
+            out = jnp.asarray(obj.convert_output(raw))
+        else:
+            out = jnp.asarray(obj.convert_output(raw[0]))[None, :]
+        return raw.T, out.T
+
+    def _compile_all(self) -> None:
+        # donate the request buffer so XLA reuses it in place — accelerator
+        # backends only (CPU cannot alias and would warn per compile)
+        donate = ((1,) if jax.default_backend() in ("tpu", "gpu", "cuda")
+                  else ())
+        jitted = jax.jit(self._pipeline, donate_argnums=donate)
+        for b in self.buckets:
+            xsh = _row_sharding(self._mesh, b)
+            spec = jax.ShapeDtypeStruct((b, self.num_features), jnp.float32,
+                                        sharding=xsh)
+            self._compiled[b] = jitted.lower(self._ens, spec).compile()
+            self._in_shardings[b] = xsh
+            self.compile_count += 1
+        Log.debug("PredictorArtifact %s: compiled %d bucket programs %s",
+                  self.name, self.compile_count, self.buckets)
+
+    def _bucket_for(self, rows: int) -> int:
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return self.buckets[-1]
+
+    # ------------------------------------------------------------------
+    def predict(self, X, raw_score: bool = False) -> np.ndarray:
+        """Serve one request: ``[N, F]`` raw features -> ``[N]`` (or
+        ``[N, K]`` multiclass) predictions.  Never compiles: the request is
+        padded to the nearest bucket and chunked by the largest one."""
+        X = np.asarray(getattr(X, "values", X))
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.shape[1] != self.num_features:
+            raise LightGBMError(
+                f"artifact {self.name!r} expects {self.num_features} "
+                f"features, request has {X.shape[1]}")
+        n = X.shape[0]
+        K = self.num_class
+        out = np.empty((n, K), np.float64)
+        X32 = np.ascontiguousarray(X, np.float32)
+        cap = self.buckets[-1]
+        for s in range(0, n, cap):
+            chunk = X32[s:s + cap]
+            b = self._bucket_for(chunk.shape[0])
+            if chunk.shape[0] == b:     # exact fill: skip the pad copy
+                xp = chunk
+            else:
+                xp = np.zeros((b, self.num_features), np.float32)
+                xp[:chunk.shape[0]] = chunk
+            with _EXEC_LOCK:
+                # place with the compiled sharding, then hand the buffer
+                # over (donate_argnums lets XLA reuse it in place)
+                xdev = jax.device_put(xp, self._in_shardings[b])
+                raw, trans = self._compiled[b](self._ens, xdev)
+                picked = np.asarray(raw if raw_score else trans)
+            out[s:s + chunk.shape[0]] = picked[:chunk.shape[0]]
+        return out[:, 0] if K == 1 else out
+
+    # ------------------------------------------------------------------
+    def parity_check(self, X, atol: float = 1e-5,
+                     rtol: float = 1e-5) -> Tuple[bool, str]:
+        """Hot-swap gate: the compiled pipeline vs an independent host-side
+        per-tree reference on the same sample.  Catches a frozen artifact
+        whose programs are wrong (miscompile, corrupted arrays, wrong
+        transform) BEFORE it takes traffic.  Returns (ok, reason)."""
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        got = np.asarray(self.predict(X), np.float64)
+        if not np.all(np.isfinite(got)):
+            return False, "non-finite outputs from compiled pipeline"
+        ref = self._host_reference(X)
+        if got.shape != ref.shape:
+            return False, f"shape mismatch: {got.shape} vs {ref.shape}"
+        if not np.allclose(got, ref, atol=atol, rtol=rtol):
+            worst = float(np.max(np.abs(got - ref)))
+            return False, f"compiled/host mismatch (max abs err {worst:g})"
+        return True, "ok"
+
+    def _host_reference(self, X: np.ndarray) -> np.ndarray:
+        K = self.num_class
+        raw = np.zeros((X.shape[0], K))
+        for ti, t in enumerate(self._gbdt.models):
+            raw[:, ti % K] += t.predict(X)
+        obj = self._objective
+        if obj is None:
+            out = raw
+        elif K > 1:
+            out = np.asarray(obj.convert_output(raw.T)).T
+        else:
+            out = np.asarray(obj.convert_output(raw[:, 0]))[:, None]
+        return np.asarray(out[:, 0] if K == 1 else out, np.float64)
+
+    def __repr__(self) -> str:
+        return (f"PredictorArtifact(name={self.name!r}, "
+                f"trees={self.num_trees}, num_class={self.num_class}, "
+                f"features={self.num_features}, buckets={self.buckets}, "
+                f"compiles={self.compile_count})")

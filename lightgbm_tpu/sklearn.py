@@ -178,7 +178,7 @@ class LGBMModel(_SKLBase):
             eval_set=None, eval_names=None, eval_sample_weight=None,
             eval_group=None, eval_metric=None, early_stopping_rounds=None,
             feature_name="auto", categorical_feature="auto", callbacks=None,
-            verbose: Any = False):
+            init_model=None, verbose: Any = False):
         if not _is_sparse(X) and not _is_dataframe(X):
             # DataFrames pass through untouched so Dataset's pandas path
             # (category-dtype -> codes, auto feature names) applies;
@@ -232,11 +232,16 @@ class LGBMModel(_SKLBase):
                                               reference=train_set))
                 valid_names.append(eval_names[i] if eval_names else f"valid_{i}")
 
+        if isinstance(init_model, LGBMModel):
+            # reference sklearn wrapper: continued training accepts a
+            # filename, a Booster, or another fitted estimator
+            init_model = init_model.booster_
+
         self._evals_result = {}
         self._Booster = train(
             params, train_set, num_boost_round=self.n_estimators,
             valid_sets=valid_sets or None, valid_names=valid_names or None,
-            fobj=fobj, feval=feval,
+            fobj=fobj, feval=feval, init_model=init_model,
             early_stopping_rounds=early_stopping_rounds,
             verbose_eval=verbose, evals_result=self._evals_result,
             callbacks=callbacks)

@@ -4,7 +4,8 @@ The axon tunnel can wedge for hours (see README round-3 notes); when a
 recovery window appears, this packs the whole perf story into ONE process
 so nothing is wasted.  The suite is a sequence of NAMED PHASES —
 
-    sanity → parity → hist_micro → grow_sweep → headline → headline_big
+    sanity → parity → hist_micro → grow_sweep → headline → bench_serve
+    → headline_big
 
 — each wrapped so a crash records an error and degrades to the next phase
 (parity is the exception: a wrong kernel must abort before any perf number
@@ -42,7 +43,7 @@ OUT = os.environ.get("WATCHER_PERF_LOG") or os.path.join(
 ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
 
 PHASES = ("sanity", "parity", "hist_micro", "grow_sweep",
-          "headline", "headline_big")
+          "headline", "bench_serve", "headline_big")
 
 
 def emit(**kv):
@@ -320,6 +321,33 @@ def phase_headline(ctx):
             else {"error": buf.getvalue()[-300:]}))
 
 
+def phase_bench_serve(ctx):
+    # serving p50/p99 + rows/s (scripts/bench_serve.py, docs/SERVING.md):
+    # FAULT-ISOLATED in its own budgeted subprocess — an AOT-lowering crash
+    # or hang in the serving path must not cost the already-captured
+    # training numbers (nor the 10.5M headline still owed after it)
+    import bench
+    sup = bench._load_supervise()
+    env = dict(os.environ)
+    env["BENCH_SKIP_PROBE"] = "1"          # the suite already proved it live
+    res = sup.run_stage(
+        "bench_serve",
+        [sys.executable, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "bench_serve.py")],
+        timeout=float(os.environ.get("TPU_SUITE_SERVE_TIMEOUT", 1200)),
+        env=env)
+    payload = sup.extract_json_line(res.output_tail)
+    if payload is not None:
+        # nest, don't splat: a crash mid-bench leaves one of bench_serve's
+        # OWN stage-keyed progress records as the last json line, and
+        # **payload would collide with stage= (the watcher nests too)
+        emit(stage="bench_serve", subprocess_status=res.status,
+             result=payload)
+    else:
+        emit(stage="bench_serve", subprocess_status=res.status,
+             error=res.output_tail[-300:])
+
+
 def phase_headline_big(ctx):
     # real-Higgs scale: one 10.5M-row single-chip run (VERDICT r4 item 4;
     # ~0.3 GB of bins) with the device-memory high-water in the detail.
@@ -351,7 +379,8 @@ def phase_headline_big(ctx):
 
 PHASE_FNS = {"sanity": phase_sanity, "parity": phase_parity,
              "hist_micro": phase_hist_micro, "grow_sweep": phase_grow_sweep,
-             "headline": phase_headline, "headline_big": phase_headline_big}
+             "headline": phase_headline, "bench_serve": phase_bench_serve,
+             "headline_big": phase_headline_big}
 
 
 def main():

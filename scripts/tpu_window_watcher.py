@@ -56,7 +56,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-STAGE_NAMES = ("parity", "perf_suite", "onehot_shootout", "headline")
+STAGE_NAMES = ("parity", "perf_suite", "onehot_shootout", "headline",
+               "bench_serve")
 JOURNAL_VERSION = 1
 
 
@@ -197,7 +198,8 @@ def stage_table(args) -> list:
     t = {"parity": args.stage_timeout or 1800,
          "perf_suite": args.stage_timeout or 7200,
          "onehot_shootout": args.stage_timeout or 3600,
-         "headline": args.stage_timeout or 3600}
+         "headline": args.stage_timeout or 3600,
+         "bench_serve": args.stage_timeout or 1800}
     if fake:
         return [(n, [py, me, "--fake-stage", n], t[n], {})
                 for n in STAGE_NAMES]
@@ -217,6 +219,12 @@ def stage_table(args) -> list:
          t["onehot_shootout"], {"BENCH_SKIP_PROBE": "1"}),
         ("headline", [py, os.path.join(REPO, "bench.py")],
          t["headline"], {"BENCH_SKIP_PROBE": "1"}),
+        # serving p50/p99 + rows/s (docs/SERVING.md); the suite's OWN
+        # bench_serve phase is skipped when the watcher drives it (below),
+        # so a window prices serving exactly once
+        ("bench_serve", [py, os.path.join(REPO, "scripts",
+                                          "bench_serve.py")],
+         t["bench_serve"], {"BENCH_SKIP_PROBE": "1"}),
     ]
 
 
@@ -293,6 +301,12 @@ def run_pipeline(args, j: dict, hb) -> str:
                 # a suite killed mid-phase left suite_phase_done markers
                 # in perf_results.jsonl; let it skip what already landed
                 env["TPU_SUITE_RESUME"] = "1"
+            # the watcher has its OWN bench_serve stage (last in the
+            # pipeline): skip the suite's copy so a window prices serving
+            # once — unlike the parity skip this is unconditional, because
+            # the watcher's stage runs regardless of the suite's outcome
+            env["TPU_SUITE_SKIP_PHASES"] = ",".join(filter(None, [
+                env.get("TPU_SUITE_SKIP_PHASES", ""), "bench_serve"]))
             if parity_ok:
                 # the watcher's parity stage IS bench_dual: don't burn
                 # window time re-running the same checks in the suite's

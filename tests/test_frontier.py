@@ -91,14 +91,53 @@ def test_max_depth_and_bagging_parity(clf_data):
 
 
 def test_ineligible_falls_back(clf_data):
-    # monotone constraints couple leaves across the split order: frontier
-    # must transparently take the sequential grower and still train
+    # monotone intermediate/advanced propagate bounds ACROSS leaves (split-
+    # order coupled): frontier must transparently take the sequential
+    # grower and still train (basic mode is served natively, see below)
     X, y = clf_data
     p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
          "tree_grower": "frontier",
+         "monotone_constraints_method": "intermediate",
          "monotone_constraints": [1] + [0] * (X.shape[1] - 1)}
     bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=3)
     assert bst.num_trees() == 3
+
+
+# ---------------------------------------------------------------------------
+# monotone-basic served by the frontier (ROADMAP item 5a): bounds pinch at
+# the midpoint down the root path — exactly the per-leaf state the frontier
+# tracks, so parity with the sequential grower must be exact
+@pytest.fixture(scope="module")
+def mono_data():
+    rng = np.random.default_rng(0)
+    n = 3000
+    X = rng.uniform(-2, 2, (n, 4)).astype(np.float32)
+    y = (1.5 * X[:, 0] + np.sin(2 * X[:, 1]) + 0.3 * X[:, 2] ** 2
+         - 0.8 * X[:, 3] + rng.normal(0, 0.2, n))
+    return X, y
+
+
+@pytest.mark.parametrize("extra", [
+    {},                                          # plain basic bounds
+    {"monotone_penalty": 1.5},                   # + depth-scaled penalty
+    {"max_depth": 5, "frontier_k": 4},           # + depth gate, small batch
+])
+def test_monotone_basic_parity(mono_data, extra):
+    X, y = mono_data
+    bs, bf = _models({"objective": "regression", "num_leaves": 31,
+                      "monotone_constraints": [1, 0, 0, -1], **extra},
+                     X, y, rounds=5)
+    _assert_identical(bs, bf, X)
+
+
+def test_monotone_basic_frontier_is_monotone(mono_data):
+    X, y = mono_data
+    p = {"objective": "regression", "num_leaves": 63, "verbose": -1,
+         "monotone_constraints": [1, 0, 0, -1], "tree_grower": "frontier"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 15)
+    from tests.test_constraints import _monotone_violation
+    assert _monotone_violation(bst, X, 0, +1) <= 1e-10
+    assert _monotone_violation(bst, X, 3, -1) <= 1e-10
 
 
 def test_sparse_efb_parity():

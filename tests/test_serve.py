@@ -1,0 +1,487 @@
+"""Serving subsystem tests (lightgbm_tpu/serve, docs/SERVING.md).
+
+CPU-only and fast: tiny models, tiny buckets — the point is exactness and
+protocol correctness, not throughput.  Covers the acceptance criteria of
+ROADMAP item 3 / ISSUE 13: artifact save/load round trip, bit-exact parity
+of ``PredictorArtifact.predict`` vs ``GBDT.predict`` (device path), bucket
+padding/chunking, zero per-request compiles, micro-batch coalescing,
+queue-saturation shedding, and hot-swap with zero dropped requests.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve import (MicroBatcher, Predictor, PredictorArtifact,
+                                QueueSaturatedError)
+
+pytestmark = pytest.mark.serve
+
+BUCKETS = (64, 256)
+
+
+@pytest.fixture(scope="module")
+def serve_data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(600, 8))
+    y = (X[:, 0] + np.sin(X[:, 1]) + 0.2 * rng.normal(size=600) > 0
+         ).astype(np.float64)
+    return X, y
+
+
+def _train(X, y, rounds=8, **extra):
+    # pred_device=device: the booster's own predict runs the SAME stacked
+    # device program the artifact AOT-compiles, so parity can be bit-exact
+    p = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+         "pred_device": "device", "serve_buckets": list(BUCKETS), **extra}
+    return lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                     num_boost_round=rounds)
+
+
+@pytest.fixture(scope="module")
+def booster(serve_data):
+    X, y = serve_data
+    return _train(X, y)
+
+
+@pytest.fixture(scope="module")
+def artifact(booster):
+    return PredictorArtifact.freeze(booster)
+
+
+@pytest.fixture(scope="module")
+def artifact_b(booster, serve_data):
+    """A genuinely different second model (same training program — only
+    the round count differs — so the module pays for ONE train compile)."""
+    X, y = serve_data
+    return PredictorArtifact.freeze(_train(X, y, rounds=16))
+
+
+# ---------------------------------------------------------------------------
+# artifact: parity, padding, chunking, compile accounting
+def test_artifact_bit_exact_vs_gbdt_predict(serve_data, booster, artifact):
+    X, _ = serve_data
+    for n in (1, 63, 64, 65, 256, 600):     # below/at/above every bucket
+        got = artifact.predict(X[:n])
+        exp = np.asarray(booster.predict(X[:n]), np.float64)
+        assert got.shape == exp.shape
+        assert np.array_equal(got, exp), f"rows={n}"
+    raw = artifact.predict(X[:100], raw_score=True)
+    raw_exp = np.asarray(booster.predict(X[:100], raw_score=True), np.float64)
+    assert np.array_equal(raw, raw_exp)
+
+
+def test_artifact_padding_does_not_leak(serve_data, artifact):
+    # a padded request (1 row in a 64-row bucket) must equal the same row
+    # inside a full bucket: pad rows are traversed but row-independent
+    X, _ = serve_data
+    full = artifact.predict(X[:64])
+    for i in (0, 7, 63):
+        one = artifact.predict(X[i:i + 1])
+        assert np.array_equal(one, full[i:i + 1])
+    # empty request: shaped, no crash, no compile
+    assert artifact.predict(np.zeros((0, X.shape[1]))).shape == (0,)
+
+
+def test_artifact_no_per_request_compiles(serve_data, artifact):
+    X, _ = serve_data
+    assert artifact.compile_count == len(BUCKETS)
+    for n in (1, 3, 64, 100, 300, 600):
+        artifact.predict(X[:n])
+    # every size above was served by the SAME finite program set
+    assert artifact.compile_count == len(BUCKETS)
+
+
+def test_artifact_save_load_roundtrip(tmp_path, serve_data, artifact):
+    X, _ = serve_data
+    path = str(tmp_path / "artifact.txt")
+    artifact.save(path)
+    loaded = PredictorArtifact.load(path)
+    # serving meta survives the file
+    assert loaded.buckets == artifact.buckets
+    assert loaded.name == artifact.name
+    # a restart never retraces from text per request: all compiles happen
+    # at load, none during serving
+    assert loaded.compile_count == len(BUCKETS)
+    assert np.array_equal(loaded.predict(X), artifact.predict(X))
+    assert loaded.compile_count == len(BUCKETS)
+    # the artifact file is still a plain model file for Booster
+    bst2 = lgb.Booster(model_file=path)
+    assert bst2.num_trees() == artifact.num_trees
+
+
+def test_artifact_multiclass_parity(serve_data):
+    X, _ = serve_data
+    y3 = np.digitize(X[:, 0] + X[:, 1], [-0.5, 0.5]).astype(np.float64)
+    p = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+         "verbose": -1, "pred_device": "device"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y3, params=p), num_boost_round=3)
+    art = PredictorArtifact.freeze(bst, buckets=[100])  # 100 % 8 != 0:
+    got = art.predict(X[:77])                           # replicated sharding
+    assert np.array_equal(got, np.asarray(bst.predict(X[:77]), np.float64))
+    assert np.allclose(got.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_artifact_feature_mismatch_refused(artifact):
+    with pytest.raises(lgb.LightGBMError, match="features"):
+        artifact.predict(np.zeros((4, 3)))
+
+
+def test_artifact_parity_gate(serve_data, artifact):
+    X, _ = serve_data
+    ok, reason = artifact.parity_check(X[:100])
+    assert ok, reason
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher: coalescing, fan-out, shedding
+def test_batcher_coalesces_and_fans_out(serve_data, artifact):
+    X, _ = serve_data
+    mb = MicroBatcher(artifact.predict, max_batch_rows=BUCKETS[-1],
+                      deadline_ms=30.0, queue_depth=64, name="t")
+    try:
+        futs = [mb.submit(X[i * 10:(i + 1) * 10]) for i in range(12)]
+        outs = [f.result(timeout=30) for f in futs]
+    finally:
+        mb.close()
+    direct = artifact.predict(X[:120])
+    for i, out in enumerate(outs):
+        assert np.array_equal(out, direct[i * 10:(i + 1) * 10])
+    assert mb.stats["requests"] == 12
+    # the 30ms deadline coalesced at least some requests into shared batches
+    assert mb.stats["batches"] < 12
+    assert mb.stats["max_batch_requests"] > 1
+
+
+def test_batcher_queue_saturation_sheds(serve_data):
+    X, _ = serve_data
+    release = threading.Event()
+
+    def slow_predict(xb):
+        release.wait(10)
+        return np.zeros(xb.shape[0])
+
+    mb = MicroBatcher(slow_predict, max_batch_rows=1, deadline_ms=0.0,
+                      queue_depth=2, name="sat")
+    try:
+        first = mb.submit(X[:1])          # worker picks this up and blocks
+        time.sleep(0.1)
+        mb.submit(X[:1])                  # fills queue slot 1
+        mb.submit(X[:1])                  # fills queue slot 2
+        with pytest.raises(QueueSaturatedError, match="saturated"):
+            mb.submit(X[:1])              # clear refusal, no blocking
+        assert mb.stats["shed"] == 1
+        release.set()
+        first.result(timeout=10)          # shed requests did not kill others
+    finally:
+        release.set()
+        mb.close()
+
+
+def test_batcher_refuses_mismatched_width(serve_data, artifact):
+    # one malformed request must be refused at submit, not poison a
+    # coalesced batch (np.concatenate would kill the worker for everyone)
+    X, _ = serve_data
+    mb = MicroBatcher(artifact.predict, deadline_ms=5.0, queue_depth=16,
+                      name="w", num_features=artifact.num_features)
+    try:
+        with pytest.raises(lgb.LightGBMError, match="features"):
+            mb.submit(X[:2, :3])
+        out = mb.predict(X[:4], timeout=30)      # batcher still healthy
+        assert np.array_equal(out, artifact.predict(X[:4]))
+    finally:
+        mb.close()
+
+
+def test_batcher_submit_after_close_refused():
+    mb = MicroBatcher(lambda xb: np.zeros(xb.shape[0]), name="done")
+    mb.close()
+    with pytest.raises(lgb.LightGBMError, match="closed"):
+        mb.submit(np.zeros((1, 2)))
+
+
+def test_mixed_width_batch_isolates_stale_requests(serve_data, artifact):
+    # simulate a redeploy changing the accepted width while a stale-width
+    # request is already queued (Predictor._retune_batcher flips
+    # _n_features): the stale request must fail alone, not poison the
+    # coalesced batch for valid new-width requests
+    X, _ = serve_data
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def gated_predict(xb):
+        entered.set()
+        gate.wait(10)
+        return artifact.predict(xb)
+
+    mb = MicroBatcher(gated_predict, max_batch_rows=BUCKETS[-1],
+                      deadline_ms=40.0, queue_depth=16, name="mix",
+                      num_features=3)
+    try:
+        first = mb.submit(X[:1, :3])     # worker blocks inside predict
+        assert entered.wait(5)
+        stale = mb.submit(X[:2, :3])     # old width, queued
+        mb._n_features = X.shape[1]      # what _retune_batcher does
+        fresh = mb.submit(X[:2])         # new width, same coalesced batch
+        gate.set()
+        assert np.array_equal(fresh.result(timeout=30),
+                              artifact.predict(X[:2]))
+        with pytest.raises(lgb.LightGBMError, match="features"):
+            stale.result(timeout=30)
+        with pytest.raises(lgb.LightGBMError, match="features"):
+            first.result(timeout=30)
+    finally:
+        gate.set()
+        mb.close()
+
+
+def test_batcher_close_with_full_queue_does_not_block(serve_data):
+    # a wedged predict_fn pins the worker while the queue sits full;
+    # close() must honor its timeout (failing the doomed pending requests)
+    # instead of blocking forever on the sentinel put
+    X, _ = serve_data
+    gate = threading.Event()
+
+    def wedged(xb):
+        gate.wait(10)
+        return np.zeros(xb.shape[0])
+
+    mb = MicroBatcher(wedged, max_batch_rows=1, deadline_ms=0.0,
+                      queue_depth=2, name="wedge")
+    first = mb.submit(X[:1])          # worker picks this up and wedges
+    time.sleep(0.1)
+    pend = [mb.submit(X[:1]), mb.submit(X[:1])]    # queue now full
+    t0 = time.monotonic()
+    mb.close(timeout=0.2)
+    assert time.monotonic() - t0 < 5
+    for f in pend:
+        with pytest.raises(lgb.LightGBMError, match="closed"):
+            f.result(timeout=5)
+    gate.set()                        # worker finishes, pops the sentinel
+    assert first.result(timeout=10).shape == (1,)
+    mb._worker.join(5)
+    assert not mb._worker.is_alive()
+
+
+def test_batcher_close_mid_batch_worker_exits(serve_data):
+    # close() whose join times out mid-batch must not let _fail_pending eat
+    # the stop sentinel: the worker would block on get() forever, leaking a
+    # daemon thread that pins the artifact for the life of the process
+    X, _ = serve_data
+    release = threading.Event()
+
+    def slow_predict(xb):
+        release.wait(10)
+        return np.zeros(xb.shape[0])
+
+    mb = MicroBatcher(slow_predict, max_batch_rows=1, deadline_ms=0.0,
+                      queue_depth=4, name="slowclose")
+    fut = mb.submit(X[:1])
+    time.sleep(0.1)                   # worker is now inside predict_fn
+    mb.close(timeout=0.05)            # join times out with the batch live
+    release.set()                     # the batch finishes AFTER close
+    assert fut.result(timeout=10).shape == (1,)
+    mb._worker.join(timeout=5)        # re-sent sentinel: worker exits
+    assert not mb._worker.is_alive()
+
+
+def test_batcher_worker_error_propagates(serve_data):
+    X, _ = serve_data
+
+    def broken(xb):
+        raise ValueError("boom")
+
+    mb = MicroBatcher(broken, deadline_ms=0.0, queue_depth=4, name="err")
+    try:
+        with pytest.raises(ValueError, match="boom"):
+            mb.submit(X[:2]).result(timeout=10)
+        # a predict_fn error is a PER-BATCH failure: the worker stays
+        # healthy and keeps serving
+        with pytest.raises(ValueError, match="boom"):
+            mb.submit(X[:2]).result(timeout=10)
+    finally:
+        mb.close()
+
+
+def test_batcher_worker_crash_refuses_new_submits(serve_data):
+    # a crash OUTSIDE the per-batch guard kills the worker: pending futures
+    # fail, and later submits are refused instead of queueing forever
+    X, _ = serve_data
+    mb = MicroBatcher(lambda xb: np.zeros(xb.shape[0]), deadline_ms=0.0,
+                      queue_depth=4, name="crash")
+
+    def bomb(batch):
+        raise RuntimeError("hard crash")
+
+    mb._run_batch = bomb
+    with pytest.raises(RuntimeError, match="hard crash"):
+        mb.submit(X[:1]).result(timeout=10)
+    mb._worker.join(5)
+    with pytest.raises(lgb.LightGBMError, match="died"):
+        mb.submit(X[:1])
+    mb.close()
+
+
+def test_queue_saturated_error_top_level_export():
+    # clients are told to catch the shed exception; it must be reachable
+    # the same way LightGBMError is
+    assert lgb.QueueSaturatedError is QueueSaturatedError
+
+
+# ---------------------------------------------------------------------------
+# server: routing + hot-swap
+def test_predictor_routing_and_unknown_model(serve_data, artifact):
+    X, _ = serve_data
+    srv = Predictor(artifact)
+    try:
+        assert np.array_equal(srv.predict(X[:10]), artifact.predict(X[:10]))
+        with pytest.raises(lgb.LightGBMError, match="unknown model"):
+            srv.predict(X[:10], model="nope")
+        info = srv.models()["default"]
+        assert info["generation"] == 1 and not info["staged"]
+    finally:
+        srv.close()
+
+
+def test_hot_swap_zero_dropped_requests(serve_data, artifact, artifact_b):
+    """Concurrent requests during a swap: every request completes, every
+    response matches exactly one of the two model versions, and requests
+    issued after swap() returns are served by the NEW model only."""
+    X, y = serve_data
+    art_b = artifact_b
+    exp_a = artifact.predict(X[:32])
+    exp_b = art_b.predict(X[:32])
+    assert not np.array_equal(exp_a, exp_b)
+
+    srv = Predictor(artifact)
+    results, errors = [], []
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                results.append(np.asarray(srv.predict(X[:32])))
+            except Exception as e:       # any drop/refusal fails the test
+                errors.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.2)
+        srv.stage("default", art_b)
+        gen = srv.swap("default", parity_X=X[:64])
+        after_swap = srv.predict(X[:32])  # post-swap: new model, immediately
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        srv.close()
+    assert not errors, errors[:3]
+    assert gen == 2
+    assert np.array_equal(after_swap, exp_b)
+    assert len(results) > 0
+    for r in results:                     # zero stale/corrupt responses
+        assert (np.array_equal(r, exp_a) or np.array_equal(r, exp_b))
+
+
+def test_hot_swap_parity_gate_rolls_back(serve_data, artifact, artifact_b,
+                                         monkeypatch):
+    X, y = serve_data
+    art_b = artifact_b
+    srv = Predictor(artifact)
+    try:
+        # sabotage the staged artifact's gate: the swap must refuse and the
+        # LIVE model must keep serving
+        monkeypatch.setattr(art_b, "parity_check",
+                            lambda *a, **k: (False, "injected failure"))
+        srv.stage("default", art_b)
+        before = srv.predict(X[:16])
+        with pytest.raises(lgb.LightGBMError, match="injected failure"):
+            srv.swap("default", parity_X=X[:16])
+        assert np.array_equal(srv.predict(X[:16]), before)
+        info = srv.models()["default"]
+        assert info["generation"] == 1 and not info["staged"]
+    finally:
+        srv.close()
+
+
+def test_hot_swap_rejects_shape_changing_artifact(serve_data, artifact):
+    # a swap that would change the response shape ([N] -> [N, K]) must be
+    # refused before the flip — clients were promised a contract
+    X, _ = serve_data
+    y3 = np.digitize(X[:, 0], [-0.5, 0.5]).astype(np.float64)
+    p = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+         "verbose": -1}
+    mc = lgb.train(p, lgb.Dataset(X, label=y3, params=p), num_boost_round=2)
+    art_mc = PredictorArtifact.freeze(mc, buckets=[64])
+    srv = Predictor(artifact)
+    try:
+        srv.stage("default", art_mc)
+        with pytest.raises(lgb.LightGBMError, match="rejected"):
+            srv.swap("default")
+        assert srv.models()["default"]["generation"] == 1
+    finally:
+        srv.close()
+
+
+def test_hot_swap_rollback_restores_previous(serve_data, artifact, artifact_b):
+    X, y = serve_data
+    art_b = artifact_b
+    srv = Predictor(artifact)
+    try:
+        srv.stage("default", art_b)
+        srv.swap("default", parity_X=X[:32])
+        assert np.array_equal(srv.predict(X[:8]), art_b.predict(X[:8]))
+        srv.rollback("default")
+        assert np.array_equal(srv.predict(X[:8]), artifact.predict(X[:8]))
+    finally:
+        srv.close()
+
+
+def test_redeploy_width_change_retunes_batcher(serve_data, artifact):
+    # deploy() bypasses swap's same-shape gate, so a redeploy may change the
+    # feature count; the batcher must follow the LIVE artifact or it would
+    # refuse every valid request until a restart
+    X, y = serve_data
+    narrow = PredictorArtifact.freeze(_train(X[:, :4], y, rounds=2),
+                                      buckets=[32])
+    srv = Predictor(artifact, batching=True, deadline_ms=1.0)
+    try:
+        assert np.array_equal(srv.predict(X[:4], timeout=30),
+                              artifact.predict(X[:4]))
+        srv.deploy("default", narrow)
+        out = srv.predict(X[:4, :4], timeout=30)    # new width must serve
+        assert np.array_equal(out, narrow.predict(X[:4, :4]))
+        with pytest.raises(lgb.LightGBMError, match="features"):
+            srv.submit(X[:4])                       # old width now refused
+    finally:
+        srv.close()
+
+
+def test_predictor_batched_serving(serve_data, artifact):
+    X, _ = serve_data
+    srv = Predictor(artifact, batching=True, deadline_ms=20.0)
+    try:
+        futs = [srv.submit(X[i:i + 1]) for i in range(20)]
+        direct = artifact.predict(X[:20])
+        for i, f in enumerate(futs):
+            assert np.array_equal(f.result(timeout=30), direct[i:i + 1])
+    finally:
+        srv.close()
+
+
+def test_serve_config_knobs_validate():
+    with pytest.raises(lgb.LightGBMError):
+        lgb.Config.from_params({"serve_buckets": []})
+    with pytest.raises(lgb.LightGBMError):
+        lgb.Config.from_params({"serve_buckets": [0, 64]})
+    with pytest.raises(lgb.LightGBMError):
+        lgb.Config.from_params({"serve_batch_deadline_ms": -1})
+    with pytest.raises(lgb.LightGBMError):
+        lgb.Config.from_params({"serve_queue_depth": 0})
+    cfg = lgb.Config.from_params({"serve_buckets": "256,64,256"})
+    assert cfg.serve_buckets == [64, 256]

@@ -70,6 +70,71 @@ def test_eval_set_early_stopping(binary_data):
     assert "binary_logloss" in m.evals_result_["valid_0"]
 
 
+def test_eval_set_empty(binary_data):
+    """ROADMAP 5c: an explicitly EMPTY eval_set is a no-op, not a crash —
+    no valid sets are registered and early stopping has nothing to watch."""
+    Xtr, ytr, Xte, yte = binary_data
+    m = LGBMClassifier(n_estimators=8, num_leaves=15)
+    m.fit(Xtr, ytr, eval_set=[])
+    assert m.evals_result_ == {}
+    assert m.best_iteration_ <= 0 or m.best_iteration_ == 8
+    assert m.score(Xte, yte) > 0.8
+
+
+def test_eval_set_dtype_mismatch(binary_data):
+    """ROADMAP 5c: eval_set with a different dtype than train (f32 X,
+    integer y) must bin against the train mappers and evaluate — and must
+    NOT be silently aliased onto the train set by the same-data check."""
+    Xtr, ytr, Xte, yte = binary_data
+    m = LGBMClassifier(n_estimators=30, num_leaves=15, learning_rate=0.3)
+    m.fit(Xtr.astype(np.float64), ytr.astype(np.float64),
+          eval_set=[(Xte.astype(np.float32), yte.astype(np.int32))],
+          eval_metric="binary_logloss", early_stopping_rounds=5)
+    res = m.evals_result_["valid_0"]["binary_logloss"]
+    assert len(res) > 0 and np.isfinite(res).all()
+    # f32-cast TRAIN data must still alias onto the train set's scores?
+    # No: a dtype change makes values differ at f64 resolution, so the
+    # wrapper builds a real eval Dataset — both paths must evaluate close
+    m2 = LGBMClassifier(n_estimators=10, num_leaves=15)
+    m2.fit(Xtr, ytr, eval_set=[(Xtr.astype(np.float32), ytr)],
+           eval_metric="binary_logloss")
+    r2 = m2.evals_result_["valid_0"]["binary_logloss"]
+    assert len(r2) == 10 and np.isfinite(r2).all()
+
+
+def test_init_model_continuation_with_eval_set(binary_data):
+    """ROADMAP 5c: continued training (init_model) with an eval_set — the
+    warm-started model's eval history starts from the previous ensemble's
+    quality and the final model carries both runs' trees."""
+    Xtr, ytr, Xte, yte = binary_data
+    base = LGBMClassifier(n_estimators=10, num_leaves=15, learning_rate=0.2)
+    base.fit(Xtr, ytr, eval_set=[(Xte, yte)], eval_metric="binary_logloss")
+    base_last = base.evals_result_["valid_0"]["binary_logloss"][-1]
+
+    cont = LGBMClassifier(n_estimators=5, num_leaves=15, learning_rate=0.2)
+    cont.fit(Xtr, ytr, eval_set=[(Xte, yte)], eval_metric="binary_logloss",
+             init_model=base)
+    hist = cont.evals_result_["valid_0"]["binary_logloss"]
+    assert len(hist) == 5
+    assert cont.booster_.num_trees() == 15
+    # warm start: iteration 1 of the continuation is already at (or very
+    # near) the base model's final loss, not a cold start's
+    assert hist[0] < base_last * 1.10
+    # a model file path continues identically
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "base.txt")
+        base.booster_.save_model(path)
+        cont2 = LGBMClassifier(n_estimators=5, num_leaves=15,
+                               learning_rate=0.2)
+        cont2.fit(Xtr, ytr, eval_set=[(Xte, yte)],
+                  eval_metric="binary_logloss", init_model=path)
+        assert cont2.booster_.num_trees() == 15
+        np.testing.assert_allclose(
+            cont2.evals_result_["valid_0"]["binary_logloss"], hist,
+            rtol=1e-5, atol=1e-7)
+
+
 def test_custom_objective_and_eval(regression_data):
     Xtr, ytr, Xte, yte = regression_data
 
