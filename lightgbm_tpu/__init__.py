@@ -30,6 +30,12 @@ def __getattr__(name):
                 "QueueSaturatedError"):
         from . import serve as _serve
         return _serve if name == "serve" else getattr(_serve, name)
+    if name in ("DistLGBMClassifier", "DistLGBMRegressor"):
+        from .parallel import estimators as _est
+        return getattr(_est, name)
+    if name == "stream":
+        from . import stream as _stream
+        return _stream
     if name.startswith("plot_") or name in ("create_tree_digraph", "plotting"):
         import importlib
         _pl = importlib.import_module(".plotting", __name__)

@@ -338,6 +338,20 @@ class Booster:
 
     @staticmethod
     def _create_engine(cfg: Config, inner_train):
+        # out-of-core routing (lightgbm_tpu/stream, docs/STREAMING.md): when
+        # the projected device footprint exceeds the configured budget (or
+        # stream_rows forces it), train from host RAM in streamed row blocks
+        plan = (inner_train.stream_plan() if inner_train is not None
+                else None)
+        if plan is not None:
+            from .stream.booster import StreamGBDT, StreamGOSS
+            scls = {"gbdt": StreamGBDT, "goss": StreamGOSS}.get(cfg.boosting)
+            if scls is None:
+                raise LightGBMError(
+                    "out-of-core streaming supports boosting=gbdt/goss "
+                    f"(got {cfg.boosting}); raise max_bin_matrix_bytes or "
+                    "unset stream_rows to train device-resident")
+            return scls(cfg, inner_train)
         from .models.dart import DART
         from .models.goss import GOSS
         from .models.rf import RF

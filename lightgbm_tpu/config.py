@@ -329,6 +329,20 @@ class Config:
     frontier_block_rows: int = 512            # kernel rows/block (128-mult)
     mesh_shape: List[int] = field(default_factory=list)   # device mesh, [] = all devices on one axis
     pred_device: str = "auto"                 # auto | device | host ensemble predict
+    # out-of-core training (lightgbm_tpu/stream, docs/STREAMING.md): when the
+    # projected device footprint of the binned matrix exceeds this byte
+    # budget, the Dataset stays host-resident and training streams
+    # double-buffered row blocks through HBM.  0 = no budget (whole matrix
+    # device-resident, the historical behavior); the STREAM_FAKE_HBM_BYTES
+    # env var overrides it for CPU testing of the eviction/prefetch path
+    max_bin_matrix_bytes: int = 0
+    # force streaming with this row-block size (0 = decide by budget);
+    # 128-multiple so blocks tile the TPU sublane grid
+    stream_rows: int = 0
+    # row blocks in flight on device (the consumed block + prefetched
+    # ones); 2 = classic double buffering, the H2D copy of block k+1 hides
+    # behind the histogram pass on block k
+    stream_prefetch: int = 2
     # serving subsystem (lightgbm_tpu/serve, docs/SERVING.md): batch-shape
     # buckets the PredictorArtifact AOT-compiles (requests pad to the
     # nearest bucket; larger requests chunk by the biggest one)
@@ -449,6 +463,16 @@ class Config:
             raise LightGBMError("serve_batch_deadline_ms must be >= 0")
         if self.serve_queue_depth < 1:
             raise LightGBMError("serve_queue_depth must be >= 1")
+
+        if self.max_bin_matrix_bytes < 0:
+            raise LightGBMError("max_bin_matrix_bytes must be >= 0")
+        if self.stream_rows < 0 or (self.stream_rows
+                                    and self.stream_rows % 128):
+            raise LightGBMError(
+                "stream_rows must be 0 (auto) or a 128-multiple >= 128 "
+                "(row blocks tile the TPU sublane grid)")
+        if self.stream_prefetch < 1:
+            raise LightGBMError("stream_prefetch must be >= 1")
 
         self.tree_grower = self.tree_grower.lower()
         if self.tree_grower not in ("auto", "serial", "frontier"):

@@ -354,9 +354,19 @@ class Dataset:
     def _efb_config_allows(cfg, num_features: int) -> bool:
         """Config-only part of the EFB gate (shared with distributed
         ingest, which must decide before binning whether to collect a
-        planning sample)."""
+        planning sample).
+
+        Out-of-core streaming disables bundling whenever a stream budget /
+        block size is CONFIGURED (not merely triggered): the streaming
+        grower trains plain per-feature columns, and in distributed use the
+        bundle layout must be identical on every rank while the stream
+        TRIGGER is per-rank (local row counts differ) — so the EFB decision
+        may depend only on config, never on the data size."""
+        from ..stream.host_matrix import effective_budget_bytes
         return (cfg.enable_bundle and num_features > 1
-                and cfg.tree_learner not in ("feature", "voting"))
+                and cfg.tree_learner not in ("feature", "voting")
+                and not getattr(cfg, "stream_rows", 0)
+                and not effective_budget_bytes(cfg))
 
     def _efb_candidates(self):
         """(num_bins, bundleable) arrays over used features, or None when
@@ -459,9 +469,42 @@ class Dataset:
         return out
 
     # ------------------------------------------------------------------
+    # out-of-core streaming (lightgbm_tpu/stream, docs/STREAMING.md)
+    def stream_plan(self):
+        """``StreamPlan`` when this dataset should train out-of-core (its
+        projected device footprint exceeds the ``max_bin_matrix_bytes`` /
+        ``STREAM_FAKE_HBM_BYTES`` budget, or ``stream_rows`` forces it),
+        else ``None``.  The budget decision lives HERE — io owns the
+        footprint math — so every consumer (engine, distributed trainer,
+        benches) makes the identical choice."""
+        if self.bins is None:
+            return None
+        from ..stream.host_matrix import plan_streaming
+        return plan_streaming(self.num_data, self.bins.shape[1],
+                              self.bins.dtype.itemsize, self.config)
+
+    def host_bin_matrix(self, plan=None):
+        """Row-block-chunked host-RAM view of the binned matrix for the
+        streaming trainer."""
+        from ..stream.host_matrix import HostBinMatrix
+        plan = plan or self.stream_plan()
+        check(plan is not None, "host_bin_matrix needs a streaming plan")
+        return HostBinMatrix(self.bins, plan.block_rows)
+
+    def device_meta(self, monotone_constraints: Optional[Sequence[int]] = None) -> DeviceData:
+        """Per-feature metadata tensors WITHOUT the bins matrix — the
+        streaming trainer keeps bins in host RAM and moves row blocks
+        through the ``RowBlockPipeline`` instead."""
+        return self._device_tensors(monotone_constraints, with_bins=False)
+
+    # ------------------------------------------------------------------
     def device_data(self, monotone_constraints: Optional[Sequence[int]] = None) -> DeviceData:
         """Materialize device tensors (lazily cached)."""
-        if self._device is not None and monotone_constraints is None:
+        return self._device_tensors(monotone_constraints, with_bins=True)
+
+    def _device_tensors(self, monotone_constraints, with_bins: bool) -> DeviceData:
+        if (self._device is not None and monotone_constraints is None
+                and with_bins):
             return self._device
         import jax.numpy as jnp
         feats = self.used_features
@@ -502,7 +545,9 @@ class Dataset:
                    self.feat_off.astype(np.int32), nb.astype(np.int32))
             bundle_bins = int(self.bundle_widths.max())
         dd = DeviceData(
-            bins=jnp.asarray(self.bins),
+            # with_bins=False (device_meta): the matrix stays in host RAM,
+            # the streaming pipeline moves row blocks instead
+            bins=jnp.asarray(self.bins) if with_bins else None,
             num_bins=jnp.asarray(nb),
             bin_offsets=jnp.asarray(offsets),
             default_bins=jnp.asarray(default_bins),
@@ -513,7 +558,9 @@ class Dataset:
             efb=efb,
             bundle_bins=bundle_bins,
         )
-        if monotone_constraints is None:
+        if monotone_constraints is None and with_bins:
+            # cache only the full tensors: a cached bins-free DeviceData
+            # must never satisfy a later device_data() call
             self._device = dd
         return dd
 

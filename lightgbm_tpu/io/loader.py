@@ -47,9 +47,30 @@ def load_file(path: str, config: Optional[Config] = None):
     fmt = detect_file_format(path)
     if fmt == "libsvm":
         feat, label, names = _load_libsvm(path)
-        return feat, label, names, None, None
-    delim = "\t" if fmt == "tsv" else ","
-    return _load_delimited(path, delim, cfg)
+        out = (feat, label, names, None, None)
+    else:
+        delim = "\t" if fmt == "tsv" else ","
+        out = _load_delimited(path, delim, cfg)
+    _announce_stream_budget(out[0], cfg, path)
+    return out
+
+
+def _announce_stream_budget(feat, cfg: Config, path: str) -> None:
+    """Early out-of-core heads-up at FILE-load time (docs/STREAMING.md):
+    the binding decision is made post-binning by ``Dataset.stream_plan()``
+    (io owns the footprint math there too), but the u8-bin estimate here —
+    one byte per cell, exact whenever max_bin <= 256 — tells CLI users at
+    ingest that this file will train host-resident."""
+    from ..stream.host_matrix import effective_budget_bytes
+    budget = effective_budget_bytes(cfg)
+    if not budget or feat is None:
+        return
+    projected = int(np.prod(feat.shape))
+    if projected > budget:
+        Log.info(
+            "%s: projected binned footprint ~%.1f MB exceeds the %.1f MB "
+            "device budget; training will stream row blocks from host RAM "
+            "(docs/STREAMING.md)", path, projected / 1e6, budget / 1e6)
 
 
 def _load_delimited(path: str, delim: str, cfg: Config):

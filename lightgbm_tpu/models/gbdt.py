@@ -796,7 +796,8 @@ class GBDT:
                                  cegb_lazy=lazy_p, cegb_used_data=cu,
                                  forced=forced, feature_contri=contri_p)
 
-            sharded = jax.shard_map(
+            from ..parallel.mesh import shard_map as _shard_map
+            sharded = _shard_map(
                 grow, mesh=mesh,
                 in_specs=(P(None, axis), P(), P(), P(), P(), P(), P(), P()),
                 out_specs=(P(), P()), check_vma=False)
@@ -825,7 +826,8 @@ class GBDT:
                              cegb_used_data=cu, forced=forced, efb=dd.efb,
                              feature_contri=contri)
 
-        sharded = jax.shard_map(
+        from ..parallel.mesh import shard_map as _shard_map
+        sharded = _shard_map(
             grow, mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P(), P(),
                       P(axis)),
@@ -1175,7 +1177,11 @@ class GBDT:
                                  jnp.zeros_like(self._train_score),
                                  raw_of(self.train_data))
         for vi, vset in enumerate(self.valid_sets):
-            self._valid_scores[vi] = warm(vset, vset.device_data(),
+            # device_meta, not device_data: warm() only reads nan_bins, and
+            # under the streaming engine a full device_data() here would
+            # materialize (and cache) a valid bin matrix the budget says
+            # does not fit
+            self._valid_scores[vi] = warm(vset, vset.device_meta(),
                                           jnp.zeros_like(self._valid_scores[vi]),
                                           raw_of(vset))
 

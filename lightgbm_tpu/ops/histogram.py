@@ -534,3 +534,23 @@ def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
     """Sibling histogram via subtraction (reference ``FeatureHistogram::Subtract``,
     ``feature_histogram.hpp:79``)."""
     return parent - child
+
+
+def accumulate_histogram(acc: jax.Array, bins: jax.Array, grad: jax.Array,
+                         hess: jax.Array, mask: jax.Array, max_bin: int, *,
+                         method: str = "onehot", chunk_rows: int = 65536,
+                         variant: str = "base") -> jax.Array:
+    """Block-accumulating entry point: ``acc + histogram(block)``.
+
+    The out-of-core trainer (lightgbm_tpu/stream, docs/STREAMING.md) folds
+    one streamed row block into a running ``[F, B, 3]`` accumulator with
+    this op — the same shape/kernels as ``build_histogram``, so the
+    accumulated result feeds ``split.find_best_split`` /
+    ``subtract_histogram`` unchanged, and the same structure the quantized
+    histogram collectives of ROADMAP item 4 will reduce over the wire.
+    Accumulation order is block-major (f32 adds reassociate vs the
+    single-pass kernels — the sharded-learner noise class, ~2^-23 relative
+    per add)."""
+    return acc + build_histogram(bins, grad, hess, mask, max_bin,
+                                 method=method, chunk_rows=chunk_rows,
+                                 variant=variant)

@@ -362,18 +362,18 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
         hist, num_bins, nan_bins, is_categorical, monotone, total, p,
         feature_mask, parent_output, output_lo, output_hi, gain_penalty,
         rand_threshold, contri=contri)
+    # statically no many-category feature in the dataset (sorted_cat=False):
+    # the sorted scan (2 argsorts + 2 maxT-step fori_loops of tiny ops) is
+    # pure per-split overhead — skip it at trace time, and trace NO
+    # placeholder candidate arrays either: constant NEG_INF candidates fed
+    # through argmax/where under a vmapped shard_map crash XLA:CPU's
+    # sharding propagation (TileAssignment::Reshape 0-element CHECK,
+    # jaxlib 0.4.37) besides being dead weight
     if sorted_cat:
         gain_sorted, bits_sorted, left_sorted = _sorted_cat_best(
             hist, num_bins, is_categorical, monotone, total, p, feature_mask,
             parent_output, output_lo, output_hi, gain_penalty,
             contri=contri)
-    else:
-        # statically no many-category feature in the dataset: the sorted scan
-        # (2 argsorts + 2 maxT-step fori_loops of tiny ops) is pure per-split
-        # overhead — skip it at trace time
-        gain_sorted = jnp.full(max(f, 1), NEG_INF, jnp.float32)
-        bits_sorted = jnp.zeros((max(f, 1), cw), jnp.int32)
-        left_sorted = jnp.zeros((max(f, 1), 3), jnp.float32)
 
     if gain_mult is not None:
         # monotone split penalty (ComputeMonotoneSplitGainPenalty,
@@ -395,31 +395,38 @@ def find_best_split(hist: jax.Array, num_bins: jax.Array, default_bins: jax.Arra
     flat = gain_fb.reshape(-1)
     best_idx = jnp.argmax(flat)
     grid_gain = flat[best_idx]
-    # sorted-subset candidates compete per feature
-    sorted_f = jnp.argmax(gain_sorted).astype(jnp.int32) if f else jnp.int32(0)
-    use_sorted = ((gain_sorted[sorted_f] > grid_gain) if f and sorted_cat
-                  else jnp.asarray(False))
-    best_gain = jnp.where(use_sorted, gain_sorted[sorted_f], grid_gain)
-    best_f = jnp.where(use_sorted, sorted_f, (best_idx // b).astype(jnp.int32))
-    best_t = jnp.where(use_sorted, 0, (best_idx % b).astype(jnp.int32))
+    if sorted_cat:
+        # sorted-subset candidates compete per feature
+        sorted_f = (jnp.argmax(gain_sorted).astype(jnp.int32) if f
+                    else jnp.int32(0))
+        use_sorted = ((gain_sorted[sorted_f] > grid_gain) if f
+                      else jnp.asarray(False))
+        best_gain = jnp.where(use_sorted, gain_sorted[sorted_f], grid_gain)
+        best_f = jnp.where(use_sorted, sorted_f,
+                           (best_idx // b).astype(jnp.int32))
+        best_t = jnp.where(use_sorted, 0, (best_idx % b).astype(jnp.int32))
+    else:
+        best_gain = grid_gain
+        best_f = (best_idx // b).astype(jnp.int32)
+        best_t = (best_idx % b).astype(jnp.int32)
     bf_cat = is_categorical[best_f]
-    bf_missing_left = jnp.where(bf_cat, False,
-                                use_left[best_f, jnp.where(use_sorted, 0, best_t)])
+    bf_missing_left = jnp.where(bf_cat, False, use_left[best_f, best_t])
 
     # categorical membership bitset: sorted prefix, or the one-hot bin's bit
     onehot_bits = pack_bin_bitset(
         jnp.arange(b, dtype=jnp.int32) == best_t)                      # [CW]
-    cat_bits = jnp.where(use_sorted, bits_sorted[sorted_f],
-                         jnp.where(bf_cat, onehot_bits,
-                                   jnp.zeros(cw, jnp.int32)))
+    cat_bits = jnp.where(bf_cat, onehot_bits, jnp.zeros(cw, jnp.int32))
+    if sorted_cat:
+        cat_bits = jnp.where(use_sorted, bits_sorted[sorted_f], cat_bits)
 
     # recompute chosen split's child sums
     def pick(arr):
         return arr[best_f, best_t]
     left_num = pick(cum) + jnp.where(bf_missing_left, miss[best_f], 0.0)
     left_cat = pick(hist)
-    left = jnp.where(use_sorted, left_sorted[sorted_f],
-                     jnp.where(bf_cat, left_cat, left_num))
+    left = jnp.where(bf_cat, left_cat, left_num)
+    if sorted_cat:
+        left = jnp.where(use_sorted, left_sorted[sorted_f], left)
     right = total - left
 
     # categorical outputs use the categorical L2 (reference computes

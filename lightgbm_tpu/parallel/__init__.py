@@ -22,9 +22,11 @@ from .trainer import train_distributed
 from .data_parallel import make_dp_train_step, pad_rows_to_multiple, shard_rows
 from .feature_parallel import make_fp_train_step, pad_features_to_multiple
 from .voting_parallel import make_voting_train_step
+from .estimators import DistLGBMClassifier, DistLGBMRegressor
 
 __all__ = ["default_mesh", "init_distributed", "set_network",
            "free_network", "distributed_dataset", "train_distributed",
            "make_dp_train_step",
            "make_fp_train_step", "make_voting_train_step",
-           "pad_rows_to_multiple", "pad_features_to_multiple", "shard_rows"]
+           "pad_rows_to_multiple", "pad_features_to_multiple", "shard_rows",
+           "DistLGBMClassifier", "DistLGBMRegressor"]

@@ -166,38 +166,15 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
         check(group is not None,
               "ranking objectives need rank-local `group` sizes")
 
-    # --- equal per-process row blocks (pad rows ride weight 0) ----------
+    # --- host-side shard geometry (shared with the streaming branch) ----
     n_local = ds.num_data
     d_local = jax.local_device_count()
     n_locals = np.asarray(mhu.process_allgather(np.int64(n_local))).reshape(-1)
-    per_proc = int(n_locals.max())
-    per_proc = -(-per_proc // d_local) * d_local
-    pad = per_proc - n_local
-    bins_l = np.pad(np.asarray(ds.bins), ((0, pad), (0, 0)))
+    n_global = int(n_locals.sum())
+    my_off = int(n_locals[: jax.process_index()].sum())
     label_np = np.asarray(ds.metadata.label, np.float32)
-    label_l = np.pad(label_np, (0, pad))
-    rw_l = np.pad(np.ones(n_local, np.float32), (0, pad))
     w_np = (np.asarray(ds.metadata.weight, np.float32)
             if ds.metadata.weight is not None else np.ones(n_local, np.float32))
-    w_l = np.pad(w_np, (0, pad))
-    N = per_proc * jax.process_count()
-    n_global = int(n_locals.sum())
-    # TRUE global row index of every local (padded) position: bagging/GOSS
-    # draw per-row uniforms over the UNPADDED global order, so the masks
-    # match a single-process run over the concatenated rows even when
-    # shards are padded (pad rows point at 0 and ride weight 0)
-    my_off = int(n_locals[: jax.process_index()].sum())
-    gidx_l = np.pad(my_off + np.arange(n_local, dtype=np.int32), (0, pad))
-
-    mesh = Mesh(np.array(jax.devices()), (DATA_AXIS,))
-    sh = NamedSharding(mesh, P(DATA_AXIS))
-    mk = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
-        sh, a, (N,) + a.shape[1:])
-    bins_g, label_g, rw_g, w_g = mk(bins_l), mk(label_l), mk(rw_l), mk(w_l)
-    gidx_g = mk(gidx_l)
-    ksh = NamedSharding(mesh, P(None, DATA_AXIS))
-    mk_k = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
-        ksh, a, (a.shape[0], N))
 
     # --- GLOBAL boost-from-average: only the weighted label sum/count
     # crosses processes (two scalars), then the objective's own formula
@@ -233,6 +210,46 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
                         "from 0", cfg.objective)
 
     objective.init(ds.metadata, n_local)     # local stats for gradients
+
+    # --- per-rank out-of-core choice (docs/STREAMING.md): when THIS rank's
+    # bin shard exceeds the device budget, train it host-resident with
+    # streamed blocks; the cross-rank histogram reduction happens on the
+    # block-accumulated [F, B, 3] store, so ranks that stream and ranks
+    # that don't would still agree — v1 keeps one code path per run and
+    # streams everywhere once any config budget is set (the EFB gate is
+    # config-only for the same reason, io/dataset._efb_config_allows)
+    plan = ds.stream_plan()
+    if plan is not None:
+        return _train_distributed_stream(
+            cfg, ds, plan, objective, K, rounds, inits, label_np, w_np,
+            n_locals, n_global, my_off, valid_data, valid_group,
+            early_stopping_rounds, evals_result, mhu,
+            pandas_categorical)
+
+    # --- equal per-process row blocks (pad rows ride weight 0) ----------
+    per_proc = int(n_locals.max())
+    per_proc = -(-per_proc // d_local) * d_local
+    pad = per_proc - n_local
+    bins_l = np.pad(np.asarray(ds.bins), ((0, pad), (0, 0)))
+    label_l = np.pad(label_np, (0, pad))
+    rw_l = np.pad(np.ones(n_local, np.float32), (0, pad))
+    w_l = np.pad(w_np, (0, pad))
+    N = per_proc * jax.process_count()
+    # TRUE global row index of every local (padded) position: bagging/GOSS
+    # draw per-row uniforms over the UNPADDED global order, so the masks
+    # match a single-process run over the concatenated rows even when
+    # shards are padded (pad rows point at 0 and ride weight 0)
+    gidx_l = np.pad(my_off + np.arange(n_local, dtype=np.int32), (0, pad))
+
+    mesh = Mesh(np.array(jax.devices()), (DATA_AXIS,))
+    sh = NamedSharding(mesh, P(DATA_AXIS))
+    mk = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
+        sh, a, (N,) + a.shape[1:])
+    bins_g, label_g, rw_g, w_g = mk(bins_l), mk(label_l), mk(rw_l), mk(w_l)
+    gidx_g = mk(gidx_l)
+    ksh = NamedSharding(mesh, P(None, DATA_AXIS))
+    mk_k = lambda a: jax.make_array_from_process_local_data(  # noqa: E731
+        ksh, a, (a.shape[0], N))
 
     dd = ds.device_data()
     tmp = GBDT(cfg)
@@ -359,11 +376,8 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
         metrics = _pooled_metrics(cfg, objective, vds, vlabel, mhu)
 
     trees = []
-    history: dict = {}
     completed = rounds
-    first_hib = metrics[0]["higher_better"] if metrics else False
-    best_metric = -np.inf if first_hib else np.inf
-    best_iter_num, since_best = rounds, 0
+    ev_state = _EvalState(metrics, rounds)
     for it in range(rounds):
         key = key_for_iteration(cfg.seed, it, salt=1)
         g, h = compute_grads(score, it)
@@ -393,30 +407,62 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
                 leaf = np.asarray(vpredict(ta_local, vbins))
                 vscore[k] += vals_unbiased[leaf]
         if vbins is not None:
-            first = True
-            for m in metrics:
-                for name, val in m["eval"](vscore):
-                    history.setdefault(name, []).append(val)
-                    if first:
-                        better = (val > best_metric + 1e-12 if first_hib
-                                  else val < best_metric - 1e-12)
-                        if better:
-                            best_metric, best_iter_num, since_best = \
-                                val, it + 1, 0
-                        else:
-                            since_best += 1
-                        first = False
-            if (early_stopping_rounds
-                    and since_best >= early_stopping_rounds):
+            ev_state.update(metrics, vscore, it)
+            if ev_state.should_stop(early_stopping_rounds):
                 Log.info("train_distributed: early stop at iter %d "
                          "(best %.6f @ %d)", it + 1,
-                         best_metric, best_iter_num)
+                         ev_state.best_metric, ev_state.best_iter_num)
                 completed = it + 1
                 break
-    if evals_result is not None and history:
-        evals_result.setdefault("valid", {}).update(history)
+    return _assemble_booster(cfg, ds, objective, trees, inits, K, completed,
+                             ev_state, evals_result, early_stopping_rounds,
+                             pandas_categorical)
 
-    # --- identical Booster on every process -----------------------------
+
+class _EvalState:
+    """Per-iteration validation bookkeeping shared by the in-HBM and
+    streaming distributed loops (one copy of the first-metric early-stop
+    state machine — two drifting copies would silently diverge the paths'
+    best_iteration semantics)."""
+
+    def __init__(self, metrics, rounds):
+        self.history: dict = {}
+        self.first_hib = metrics[0]["higher_better"] if metrics else False
+        self.best_metric = -np.inf if self.first_hib else np.inf
+        self.best_iter_num = rounds
+        self.since_best = 0
+
+    def update(self, metrics, vscore, it):
+        first = True
+        for m in metrics:
+            for name, val in m["eval"](vscore):
+                self.history.setdefault(name, []).append(val)
+                if first:
+                    better = (val > self.best_metric + 1e-12
+                              if self.first_hib
+                              else val < self.best_metric - 1e-12)
+                    if better:
+                        self.best_metric = val
+                        self.best_iter_num = it + 1
+                        self.since_best = 0
+                    else:
+                        self.since_best += 1
+                    first = False
+
+    def should_stop(self, early_stopping_rounds) -> bool:
+        return bool(early_stopping_rounds) and \
+            self.since_best >= early_stopping_rounds
+
+
+def _assemble_booster(cfg, ds, objective, trees, inits, K, completed,
+                      ev_state, evals_result, early_stopping_rounds,
+                      pandas_categorical):
+    """Identical Booster on every process (shared by both loops)."""
+    from ..basic import Booster
+    from ..models import model_io
+    from ..models.gbdt import GBDT
+    if evals_result is not None and ev_state.history:
+        evals_result.setdefault("valid", {}).update(ev_state.history)
     gbdt = GBDT(cfg)
     gbdt.train_data = ds
     gbdt.objective = objective
@@ -425,12 +471,197 @@ def train_distributed(params, data, label, num_boost_round: Optional[int] = None
     gbdt.num_tree_per_iteration = K
     gbdt.max_feature_idx = ds.num_total_features - 1
     gbdt.iter_ = completed
-    from ..models import model_io
-    from ..basic import Booster
     bst = Booster(model_str=model_io.save_model_to_string(gbdt))
     bst.pandas_categorical = pandas_categorical
-    if history and early_stopping_rounds:
-        bst.best_iteration = best_iter_num     # sklearn/num_iteration hooks
+    if ev_state.history and early_stopping_rounds:
+        bst.best_iteration = ev_state.best_iter_num  # sklearn hooks
+    return bst
+
+
+def _train_distributed_stream(cfg, ds, plan, objective, K, rounds, inits,
+                              label_np, w_np, n_locals, n_global, my_off,
+                              valid_data, valid_group,
+                              early_stopping_rounds, evals_result, mhu,
+                              pandas_categorical):
+    """Data-parallel training over per-rank HOST-RESIDENT bin shards.
+
+    Each rank streams its local row blocks through the
+    ``stream.StreamTreeGrower``; the per-leaf ``[F, B, 3]`` histogram
+    partials accumulated block-wise on each rank are joined by an
+    allgather-sum ``cross_reduce`` — the streaming analog of
+    ``DataParallelTreeLearner``'s histogram allreduce — after which every
+    rank takes the identical split decision and repartitions its local
+    leaf vectors.  Bagging/GOSS masks are drawn over the UNPADDED global
+    row order with the same iteration keying as the in-HBM trainer, so a
+    streamed multi-process run grows the same trees as a single process
+    over the concatenated rows (tests/test_stream.py verifies the 2-shard
+    virtual-mesh analog on CPU).
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..models.gbdt import GBDT
+    from ..models.tree import Tree
+    from ..ops.predict import predict_leaf_binned
+    from ..stream.booster import (predict_leaf_blocks, stream_bag_mask,
+                                  stream_goss_sample, stream_gradients)
+    from ..stream.grower import StreamTreeGrower, make_shards
+    from ..stream.pipeline import PipelineStats
+
+    check(not getattr(objective, "is_ranking", False),
+          "distributed streaming does not support ranking objectives")
+    check(not cfg.linear_tree and not cfg.interaction_constraints
+          and not cfg.forcedsplits_filename,
+          "distributed streaming does not support linear_tree/"
+          "interaction_constraints/forced splits")
+
+    n_local = ds.num_data
+    nprocs = jax.process_count()
+
+    tmp = GBDT(cfg)
+    tmp.train_data = ds
+    tmp._dd = ds.device_meta()
+    gcfg = tmp._make_grower_cfg()
+    meta = {k: np.asarray(getattr(tmp._dd, k)) for k in
+            ("num_bins", "default_bins", "nan_bins", "is_categorical",
+             "monotone")}
+
+    def cross_reduce(arr):
+        if nprocs == 1:
+            return arr
+        pooled = np.asarray(mhu.process_allgather(np.asarray(arr)))
+        return pooled.reshape((nprocs,) + np.asarray(arr).shape).sum(axis=0)
+
+    stats = PipelineStats()
+    grower = StreamTreeGrower(
+        make_shards([ds.host_bin_matrix(plan)], plan.prefetch, stats),
+        meta, gcfg, cross_reduce=cross_reduce)
+    Log.info("train_distributed: rank %d streams %d blocks of %d rows "
+             "(local bins %.1f MB, budget %s)", jax.process_index(),
+             plan.num_blocks, plan.block_rows, plan.total_bytes / 1e6,
+             plan.budget_bytes or "stream_rows")
+
+    score = np.tile(np.asarray(inits, np.float32)[:, None], (1, n_local))
+    has_weight = ds.metadata.weight is not None
+
+    def local_grads():
+        # per-block objective eval from the host scores (shared helper:
+        # full [K, n_local] device score/grad residency would sit outside
+        # the streaming budget)
+        return stream_gradients(objective, score, label_np,
+                                w_np if has_weight else None,
+                                plan.block_rows)
+
+    # --- global-order row sampling (same keying as the in-HBM trainer) --
+    use_bagging = (cfg.boosting == "gbdt" and cfg.bagging_freq > 0
+                   and (cfg.bagging_fraction < 1.0
+                        or cfg.pos_bagging_fraction < 1.0
+                        or cfg.neg_bagging_fraction < 1.0))
+    use_goss = (cfg.boosting == "goss"
+                and cfg.top_rate + cfg.other_rate < 1.0)
+    _bag_state = {}
+
+    def sample(it, g, h):
+        if use_bagging:
+            if it % cfg.bagging_freq == 0 or "mask" not in _bag_state:
+                # this rank's window of the GLOBAL seeded draw (shared
+                # keying helper — see stream.booster.stream_bag_mask)
+                _bag_state["mask"] = stream_bag_mask(
+                    cfg, it, n_global, label_np, my_off, my_off + n_local)
+            m = _bag_state["mask"]
+            return m, g * m[None, :], h * m[None, :]
+        if use_goss:
+            imp = np.sum(np.abs(g * h), axis=0)
+            # global exact top-k: pool the (small, 4 B/row) importance
+            # vector; rank-padded gather keeps the global order, then the
+            # shared helper draws the mask over it
+            n_max = int(n_locals.max())
+            pooled = np.asarray(mhu.process_allgather(
+                np.pad(imp, (0, n_max - n_local)))).reshape(nprocs, n_max) \
+                if nprocs > 1 else imp[None, :]
+            imp_g = np.concatenate(
+                [pooled[r, :int(n_locals[r])] for r in range(nprocs)])
+            m, a = stream_goss_sample(cfg, it, imp_g, my_off,
+                                      my_off + n_local)
+            return m, g * a[None], h * a[None]
+        return np.ones(n_local, np.float32), g, h
+
+    # --- local validation shard, pooled metrics (shared helper) ---------
+    vbins = vlabel = None
+    vscore = None
+    metrics = []
+    check(valid_data is not None or not early_stopping_rounds,
+          "early_stopping_rounds requires valid_data")
+    if valid_data is not None:
+        from ..io.dataset import Dataset as InnerDataset
+        vds = InnerDataset.from_data(valid_data[0], cfg,
+                                     label=valid_data[1], reference=ds)
+        if valid_group is not None:
+            vds.metadata.set_field("group", valid_group)
+        vlabel = np.asarray(vds.metadata.label, np.float64)
+        vscore = np.tile(np.asarray(inits, np.float64)[:, None],
+                         (1, vds.num_data))
+        vnan = tmp._dd.nan_bins
+        vjit = jax.jit(lambda ta, b: predict_leaf_binned(ta, b, vnan))
+        vplan = vds.stream_plan()
+        if vplan is None:
+            vbins = jnp.asarray(vds.bins)
+
+            def vpredict(ta):
+                return np.asarray(vjit(ta, vbins))
+        else:
+            # an over-budget validation shard streams block-wise too —
+            # putting it whole would break the HBM budget this branch
+            # exists to honor (shared helper with StreamGBDT's valid path)
+            vmat = vds.host_bin_matrix(vplan)
+
+            def vpredict(ta):
+                return predict_leaf_blocks(
+                    lambda blk: vjit(ta, jnp.asarray(blk)), vmat)
+        vbins_ready = True
+        metrics = _pooled_metrics(cfg, objective, vds, vlabel, mhu)
+    else:
+        vbins_ready = False
+
+    trees = []
+    completed = rounds
+    ev_state = _EvalState(metrics, rounds)
+    for it in range(rounds):
+        g, h = local_grads()
+        rw, g, h = sample(it, g, h)
+        fmask = np.asarray(tmp._feature_mask(it), np.float32)
+        for k in range(K):
+            ta, assign = grower.grow(
+                g[k], h[k], rw, fmask,
+                key_for_iteration(cfg.seed, it, salt=k + 1))
+            nl = int(ta.num_leaves)
+            t = Tree.from_arrays(ta, ds, learning_rate=1.0)
+            t.shrink(cfg.learning_rate)
+            vals_unbiased = np.asarray(t.leaf_value, np.float64).copy()
+            if it == 0 and inits[k] != 0.0:
+                if nl > 1:
+                    t.add_bias(inits[k])
+                else:
+                    t.leaf_value = np.full_like(t.leaf_value, inits[k])
+            trees.append(t)
+            if nl > 1:
+                delta = (np.asarray(ta.leaf_value, np.float32)
+                         * np.float32(cfg.learning_rate))
+                score[k] += delta[assign]
+                if vbins_ready:
+                    ta_dev = jax.tree.map(jnp.asarray, ta)
+                    vscore[k] += vals_unbiased[vpredict(ta_dev)]
+        if vbins_ready:
+            ev_state.update(metrics, vscore, it)
+            if ev_state.should_stop(early_stopping_rounds):
+                Log.info("train_distributed(stream): early stop at iter %d "
+                         "(best %.6f @ %d)", it + 1, ev_state.best_metric,
+                         ev_state.best_iter_num)
+                completed = it + 1
+                break
+    bst = _assemble_booster(cfg, ds, objective, trees, inits, K, completed,
+                            ev_state, evals_result, early_stopping_rounds,
+                            pandas_categorical)
+    bst.stream_stats = stats
     return bst
 
 

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.grower import GrowerConfig, grow_tree
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, shard_map
 
 
 def make_voting_train_step(grower_cfg: GrowerConfig,
@@ -57,7 +57,7 @@ def make_voting_train_step(grower_cfg: GrowerConfig,
         new_score = score + jnp.where(has_split, delta[node_assign], 0.0)
         return new_score, tree
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step, mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name), P(axis_name),
                   P(), P()),

@@ -350,6 +350,14 @@ class LGBMClassifier(_SKLClassifierMixin, LGBMModel):
         self._n_classes = len(self._classes)
         self._class_map = {c: i for i, c in enumerate(self._classes)}
         y_enc = np.searchsorted(self._classes, y).astype(np.float64)
+        self._resolve_classification_objective()
+        return super().fit(X, y_enc, **kwargs)
+
+    def _resolve_classification_objective(self) -> None:
+        """Default/upgrade the objective from ``_n_classes`` (binary vs
+        multiclass + ``num_class``).  ONE copy, shared with the
+        distributed ``DistLGBMClassifier`` so the two fits cannot resolve
+        the same data to different objectives."""
         if self._objective is None or (isinstance(self._objective, str)
                                        and self._objective in ("binary", "multiclass", "multiclassova")):
             if self._n_classes > 2:
@@ -358,7 +366,6 @@ class LGBMClassifier(_SKLClassifierMixin, LGBMModel):
                 self._other_params["num_class"] = self._n_classes
             elif self._objective is None:
                 self._objective = "binary"
-        return super().fit(X, y_enc, **kwargs)
 
     def _prep_eval_label(self, y):
         return np.searchsorted(self._classes, np.asarray(y).ravel()).astype(np.float64)

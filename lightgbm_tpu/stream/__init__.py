@@ -1,0 +1,35 @@
+"""Out-of-core training: host-resident bin matrix, streamed row blocks.
+
+SCOPE.md's Criteo math (~86 GB of binned features per chip on v5e-16) puts
+the flagship distributed workload far past HBM, so the device-resident
+``Dataset.device_data()`` contract cannot serve it.  This subsystem keeps
+the binned matrix in host RAM (``HostBinMatrix``), moves it through HBM in
+double-buffered row blocks (``RowBlockPipeline`` — the ``jax.device_put``
+of block k+1 overlaps the histogram/partition pass on block k, the TPU
+analog of the GPU out-of-core block streamers of arxiv 1706.08359 /
+1806.11248), and grows trees by accumulating per-leaf histograms
+block-wise into the same ``[L, F, B, 3]`` layout ``ops/histogram.py``
+produces, so the split search (``ops/split.find_best_split``) is shared
+with the in-HBM growers unchanged.
+
+Entry points:
+- ``io.dataset.Dataset.stream_plan()`` — the budget decision
+  (``max_bin_matrix_bytes`` / ``stream_rows`` / ``STREAM_FAKE_HBM_BYTES``);
+- ``stream.booster.StreamGBDT`` / ``StreamGOSS`` — engine classes routed
+  automatically by ``Booster`` when the plan says stream;
+- ``stream.grower.StreamTreeGrower`` — one tree from host blocks, exact
+  structural parity with the serial ``ops/grower.grow_tree`` semantics;
+- ``parallel.trainer.train_distributed`` — chooses streaming per-rank
+  before its data-parallel histogram reduction.
+
+See docs/STREAMING.md for the block-size/prefetch model and the fake-HBM
+testing seam.
+"""
+from .host_matrix import HostBinMatrix, StreamPlan, plan_streaming
+from .pipeline import RowBlockPipeline
+from .grower import StreamTreeGrower
+from .booster import StreamGBDT, StreamGOSS
+
+__all__ = ["HostBinMatrix", "StreamPlan", "plan_streaming",
+           "RowBlockPipeline", "StreamTreeGrower", "StreamGBDT",
+           "StreamGOSS"]

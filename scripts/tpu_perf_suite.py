@@ -5,7 +5,7 @@ recovery window appears, this packs the whole perf story into ONE process
 so nothing is wasted.  The suite is a sequence of NAMED PHASES —
 
     sanity → parity → hist_micro → grow_sweep → headline → bench_serve
-    → headline_big
+    → bench_stream → headline_big
 
 — each wrapped so a crash records an error and degrades to the next phase
 (parity is the exception: a wrong kernel must abort before any perf number
@@ -43,7 +43,7 @@ OUT = os.environ.get("WATCHER_PERF_LOG") or os.path.join(
 ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
 
 PHASES = ("sanity", "parity", "hist_micro", "grow_sweep",
-          "headline", "bench_serve", "headline_big")
+          "headline", "bench_serve", "bench_stream", "headline_big")
 
 
 def emit(**kv):
@@ -348,6 +348,31 @@ def phase_bench_serve(ctx):
              error=res.output_tail[-300:])
 
 
+def phase_bench_stream(ctx):
+    # out-of-core streaming rows/s + H2D-overlap efficiency vs in-HBM
+    # (scripts/bench_stream.py, docs/STREAMING.md): FAULT-ISOLATED like
+    # bench_serve — a wedge in the host-paced streaming loop must not cost
+    # the captured training numbers.  --quick keeps the phase under its
+    # budget; the full sweep belongs to a dedicated window.
+    import bench
+    sup = bench._load_supervise()
+    env = dict(os.environ)
+    res = sup.run_stage(
+        "bench_stream",
+        [sys.executable, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "bench_stream.py"), "--quick"],
+        timeout=float(os.environ.get("TPU_SUITE_STREAM_TIMEOUT", 1200)),
+        env=env)
+    payload = sup.extract_json_line(res.output_tail)
+    if payload is not None:
+        # nest, don't splat (same stage=-collision reason as bench_serve)
+        emit(stage="bench_stream", subprocess_status=res.status,
+             result=payload)
+    else:
+        emit(stage="bench_stream", subprocess_status=res.status,
+             error=res.output_tail[-300:])
+
+
 def phase_headline_big(ctx):
     # real-Higgs scale: one 10.5M-row single-chip run (VERDICT r4 item 4;
     # ~0.3 GB of bins) with the device-memory high-water in the detail.
@@ -380,6 +405,7 @@ def phase_headline_big(ctx):
 PHASE_FNS = {"sanity": phase_sanity, "parity": phase_parity,
              "hist_micro": phase_hist_micro, "grow_sweep": phase_grow_sweep,
              "headline": phase_headline, "bench_serve": phase_bench_serve,
+             "bench_stream": phase_bench_stream,
              "headline_big": phase_headline_big}
 
 

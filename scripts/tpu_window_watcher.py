@@ -57,7 +57,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 STAGE_NAMES = ("parity", "perf_suite", "onehot_shootout", "headline",
-               "bench_serve")
+               "bench_serve", "bench_stream")
 JOURNAL_VERSION = 1
 
 
@@ -199,7 +199,8 @@ def stage_table(args) -> list:
          "perf_suite": args.stage_timeout or 7200,
          "onehot_shootout": args.stage_timeout or 3600,
          "headline": args.stage_timeout or 3600,
-         "bench_serve": args.stage_timeout or 1800}
+         "bench_serve": args.stage_timeout or 1800,
+         "bench_stream": args.stage_timeout or 1800}
     if fake:
         return [(n, [py, me, "--fake-stage", n], t[n], {})
                 for n in STAGE_NAMES]
@@ -225,6 +226,13 @@ def stage_table(args) -> list:
         ("bench_serve", [py, os.path.join(REPO, "scripts",
                                           "bench_serve.py")],
          t["bench_serve"], {"BENCH_SKIP_PROBE": "1"}),
+        # out-of-core streaming rows/s + H2D-overlap efficiency
+        # (docs/STREAMING.md): on hardware the overlap numbers become the
+        # real double-buffering measurement; the suite's own bench_stream
+        # phase is skipped when the watcher drives it (below)
+        ("bench_stream", [py, os.path.join(REPO, "scripts",
+                                           "bench_stream.py"), "--quick"],
+         t["bench_stream"], {"BENCH_SKIP_PROBE": "1"}),
     ]
 
 
@@ -301,12 +309,14 @@ def run_pipeline(args, j: dict, hb) -> str:
                 # a suite killed mid-phase left suite_phase_done markers
                 # in perf_results.jsonl; let it skip what already landed
                 env["TPU_SUITE_RESUME"] = "1"
-            # the watcher has its OWN bench_serve stage (last in the
-            # pipeline): skip the suite's copy so a window prices serving
-            # once — unlike the parity skip this is unconditional, because
-            # the watcher's stage runs regardless of the suite's outcome
+            # the watcher has its OWN bench_serve/bench_stream stages (last
+            # in the pipeline): skip the suite's copies so a window prices
+            # each exactly once — unlike the parity skip this is
+            # unconditional, because the watcher's stages run regardless of
+            # the suite's outcome
             env["TPU_SUITE_SKIP_PHASES"] = ",".join(filter(None, [
-                env.get("TPU_SUITE_SKIP_PHASES", ""), "bench_serve"]))
+                env.get("TPU_SUITE_SKIP_PHASES", ""), "bench_serve",
+                "bench_stream"]))
             if parity_ok:
                 # the watcher's parity stage IS bench_dual: don't burn
                 # window time re-running the same checks in the suite's

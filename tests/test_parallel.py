@@ -247,7 +247,13 @@ def test_api_tree_learner_matches_serial(learner):
     """Through the PUBLIC API, every parallel learner on the 8-device mesh
     must produce the identical model to serial training (stronger than the
     reference's quality-only Dask parity, test_dask.py)."""
-    X, y = _api_data(n=1001 if learner != "feature" else 1000)  # odd: pad path
+    # odd n exercises the data-mode row-pad path; feature mode replicates
+    # rows (f=8 divides the mesh, nothing pads either way).  n=1000 at this
+    # seed is avoided deliberately: that dataset has a genuine split-gain
+    # near-tie (two splits equal to 6 digits) which the sharded learners'
+    # different float-reduction order can legitimately flip — the exact-
+    # structure assertion below is only meaningful on tie-free data.
+    X, y = _api_data(n=1001)
     serial = _api_train("serial", X, y)
     par = _api_train(learner, X, y)
     assert serial.num_trees() == par.num_trees()

@@ -1,0 +1,407 @@
+"""Out-of-core streaming subsystem (lightgbm_tpu/stream, docs/STREAMING.md).
+
+Parity contract: the streaming path grows STRUCTURALLY IDENTICAL trees to
+the in-HBM serial grower (same split features/thresholds/children/counts)
+— gains and leaf values agree to float tolerance, because block-wise
+histogram accumulation reassociates f32 sums (the same noise class every
+sharded learner carries, see test_parallel.py).  All CPU-only, exercised
+under the synthetic HBM cap (``STREAM_FAKE_HBM_BYTES``) so eviction and
+prefetch behavior runs for real without hardware.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.stream.host_matrix import (HostBinMatrix, plan_streaming)
+from lightgbm_tpu.stream.pipeline import PipelineStats, RowBlockPipeline
+
+pytestmark = pytest.mark.stream
+
+_STRUCT_KEYS = ("split_feature=", "threshold=", "left_child=",
+                "right_child=", "leaf_count=")
+
+
+def _structure(model_str):
+    return [l for l in model_str.splitlines() if l.startswith(_STRUCT_KEYS)]
+
+
+def _train(params, X, y, rounds=4, valid=None, **dataset_kw):
+    ds = lgb.Dataset(X, label=y, params=params, **dataset_kw)
+    kw = {}
+    if valid is not None:
+        vX, vy = valid
+        kw["valid_sets"] = [lgb.Dataset(vX, label=vy, reference=ds)]
+        kw["evals_result"] = {}
+        kw["verbose_eval"] = False
+    bst = lgb.train(params, ds, num_boost_round=rounds, **kw)
+    return bst, kw.get("evals_result")
+
+
+def _parity_case(params, X, y, rounds=4, stream_rows=2048, valid=None,
+                 **dataset_kw):
+    """Train in-HBM (serial grower: the stream grower mirrors ITS split
+    order; 'auto' may take the frontier grower whose per-node RNG stream
+    legitimately differs under bynode/extra_trees) and streamed; return
+    (ref_booster, stream_booster, ref_evals, stream_evals)."""
+    base = dict(params, tree_grower="serial")
+    ref, ref_ev = _train(base, X, y, rounds, valid, **dataset_kw)
+    sp = dict(base, stream_rows=stream_rows)
+    st, st_ev = _train(sp, X, y, rounds, valid, **dataset_kw)
+    from lightgbm_tpu.stream.booster import StreamGBDT
+    assert isinstance(st._gbdt, StreamGBDT)
+    return ref, st, ref_ev, st_ev
+
+
+def _reg_data(n=20000, f=10, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 3) + X[:, 2] * X[:, 3]
+         + 0.1 * rng.normal(size=n)).astype(np.float64)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# budget decision (io/dataset.stream_plan -> stream.host_matrix)
+
+def test_plan_no_budget_fits():
+    assert plan_streaming(10_000, 10, 1, Config()) is None
+
+
+def test_plan_budget_triggers_and_sizes_blocks():
+    cfg = Config.from_params({"max_bin_matrix_bytes": 64 * 1024,
+                              "stream_prefetch": 2})
+    plan = plan_streaming(100_000, 10, 1, cfg)
+    assert plan is not None and plan.reason == "budget"
+    assert plan.block_rows % 128 == 0
+    # prefetch+1 resident blocks (bins + 16B/row sidecars) fit the budget
+    assert (plan.prefetch + 1) * plan.block_rows * (10 + 16) <= 64 * 1024
+    assert plan.num_blocks == -(-100_000 // plan.block_rows)
+    assert plan.num_blocks >= 4
+
+
+def test_plan_stream_rows_forces():
+    cfg = Config.from_params({"stream_rows": 1024})
+    plan = plan_streaming(10_000, 10, 1, cfg)
+    assert plan is not None and plan.reason == "stream_rows"
+    assert plan.block_rows == 1024 and plan.num_blocks == 10
+
+
+def test_plan_env_cap_overrides(monkeypatch):
+    monkeypatch.setenv("STREAM_FAKE_HBM_BYTES", str(32 * 1024))
+    plan = plan_streaming(100_000, 10, 1, Config())
+    assert plan is not None and plan.budget_bytes == 32 * 1024
+
+
+def test_config_validates_knobs():
+    with pytest.raises(Exception):
+        Config.from_params({"stream_rows": 100})     # not a 128-multiple
+    with pytest.raises(Exception):
+        Config.from_params({"stream_prefetch": 0})
+    with pytest.raises(Exception):
+        Config.from_params({"max_bin_matrix_bytes": -1})
+
+
+def test_efb_disabled_when_budget_configured():
+    # bundleable data: one-hot-ish sparse columns
+    rng = np.random.default_rng(0)
+    X = np.zeros((4000, 6))
+    for j in range(6):
+        rows = np.arange(j * 600, j * 600 + 400)   # disjoint: 0 conflicts
+        X[rows, j] = rng.integers(1, 5, size=400)
+    base = {"verbose": -1}
+    d0 = lgb.Dataset(X, label=np.arange(4000) % 2, params=base)
+    d0.construct()
+    assert d0._inner.bundles is not None          # EFB applies unbudgeted
+    d1 = lgb.Dataset(X, label=np.arange(4000) % 2,
+                     params=dict(base, max_bin_matrix_bytes=10**9))
+    d1.construct()
+    assert d1._inner.bundles is None              # budget => plain columns
+
+
+# ---------------------------------------------------------------------------
+# pipeline mechanics
+
+def test_pipeline_order_padding_and_peak():
+    rng = np.random.default_rng(1)
+    bins = rng.integers(0, 63, size=(10_000, 4), dtype=np.uint8)
+    m = HostBinMatrix(bins, 1024)
+    stats = PipelineStats()
+    pipe = RowBlockPipeline(m, prefetch=2, stats=stats)
+    g = np.arange(10_000, dtype=np.float32)
+    seen = []
+    for blk in pipe.blocks({"g": g}):
+        seen.append(blk.index)
+        assert blk.bins.shape == (1024, 4)         # uniform padded shape
+        got = np.asarray(blk.extras["g"])[:blk.rows]
+        np.testing.assert_array_equal(
+            got, g[blk.start:blk.start + blk.rows])
+    assert seen == list(range(m.num_blocks))
+    assert m.num_blocks == 10 and m.block_rows_actual(9) == 10_000 - 9 * 1024
+    # at most prefetch+1 blocks live at once
+    assert stats.peak_block_bytes <= 3 * (m.block_nbytes + 4 * 1024)
+    assert stats.puts == 10 and stats.passes == 1
+
+
+def test_pipeline_skip_list():
+    bins = np.zeros((4096, 2), np.uint8)
+    m = HostBinMatrix(bins, 1024)
+    stats = PipelineStats()
+    pipe = RowBlockPipeline(m, prefetch=1, stats=stats)
+    got = [b.index for b in pipe.blocks(only=[0, 3])]
+    assert got == [0, 3]
+    assert stats.blocks_skipped == 2 and stats.puts == 2
+
+
+# ---------------------------------------------------------------------------
+# training parity vs the in-HBM path
+
+@pytest.mark.parametrize("stream_rows", [2048, 4096, 8192])
+def test_parity_block_sizes(stream_rows):
+    """Identical trees + matching eval metrics at several block sizes —
+    the block decomposition must be invisible in the model."""
+    X, y = _reg_data()
+    params = {"objective": "regression", "num_leaves": 15, "max_bin": 63,
+              "verbose": -1, "seed": 7, "metric": "l2"}
+    ref, st, ref_ev, st_ev = _parity_case(
+        params, X, y, stream_rows=stream_rows,
+        valid=(X[:2000], y[:2000]))
+    assert _structure(ref.model_to_string()) == \
+        _structure(st.model_to_string())
+    np.testing.assert_allclose(st.predict(X), ref.predict(X),
+                               rtol=0, atol=1e-5)
+    np.testing.assert_allclose(st_ev["valid_0"]["l2"],
+                               ref_ev["valid_0"]["l2"], rtol=1e-6)
+
+
+def test_parity_bagging():
+    X, y = _reg_data(12000, 8)
+    yb = (y > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "verbose": -1, "seed": 7, "bagging_fraction": 0.6,
+              "bagging_freq": 2}
+    ref, st, _, _ = _parity_case(params, X, yb)
+    assert _structure(ref.model_to_string()) == \
+        _structure(st.model_to_string())
+    np.testing.assert_allclose(st.predict(X), ref.predict(X),
+                               rtol=0, atol=1e-5)
+
+
+def test_parity_goss():
+    X, y = _reg_data(12000, 8)
+    yb = (y > 0).astype(np.float64)
+    params = {"objective": "binary", "boosting": "goss", "num_leaves": 15,
+              "max_bin": 63, "verbose": -1, "seed": 7}
+    ref, st, _, _ = _parity_case(params, X, yb)
+    from lightgbm_tpu.stream.booster import StreamGOSS
+    assert isinstance(st._gbdt, StreamGOSS)
+    assert _structure(ref.model_to_string()) == \
+        _structure(st.model_to_string())
+    np.testing.assert_allclose(st.predict(X), ref.predict(X),
+                               rtol=0, atol=1e-5)
+
+
+def test_parity_bynode_extra_trees():
+    """Per-node column sampling + extra-trees thresholds reuse the serial
+    grower's split-step-keyed RNG stream, so trees match exactly."""
+    X, y = _reg_data(12000, 8)
+    params = {"objective": "regression", "num_leaves": 15, "max_bin": 63,
+              "verbose": -1, "seed": 7, "feature_fraction": 0.8,
+              "feature_fraction_bynode": 0.6, "extra_trees": True}
+    ref, st, _, _ = _parity_case(params, X, y)
+    assert _structure(ref.model_to_string()) == \
+        _structure(st.model_to_string())
+
+
+def test_parity_categorical():
+    """Categorical splits: same MODEL (splits, predictions) — the split
+    POP ORDER may differ when two leaves' best gains tie to the last float
+    bit (block-summed histograms reassociate f32 adds), renumbering
+    leaves without changing the partition, so the assertion is
+    order-insensitive: per-tree sorted split multiset + predictions."""
+    X, y = _reg_data(12000, 8)
+    rng = np.random.default_rng(11)
+    Xc = X.copy()
+    Xc[:, 2] = rng.integers(0, 12, size=len(X))
+    Xc[:, 5] = rng.integers(0, 30, size=len(X))
+    yc = (y + (Xc[:, 2] % 3) - 0.1 * (Xc[:, 5] % 7)).astype(np.float64)
+    params = {"objective": "regression", "num_leaves": 15, "max_bin": 63,
+              "verbose": -1, "seed": 7}
+    ref, st, _, _ = _parity_case(params, Xc, yc,
+                                 categorical_feature=[2, 5])
+
+    def split_multisets(bst):
+        out = []
+        for t in bst._gbdt.models:
+            out.append(sorted(zip(t.split_feature.tolist(),
+                                  [round(float(v), 6)
+                                   for v in t.threshold])))
+        return out
+    assert split_multisets(ref) == split_multisets(st)
+    np.testing.assert_allclose(st.predict(Xc), ref.predict(Xc),
+                               rtol=0, atol=1e-5)
+
+
+def test_parity_multiclass_and_renew():
+    X, y = _reg_data(9000, 6)
+    params = {"objective": "regression_l1", "num_leaves": 7, "max_bin": 63,
+              "verbose": -1, "seed": 7}
+    ref, st, _, _ = _parity_case(params, X, y)
+    assert _structure(ref.model_to_string()) == \
+        _structure(st.model_to_string())
+    # renewed leaf medians are computed from identical host state: exact
+    np.testing.assert_array_equal(st.predict(X[:500]), ref.predict(X[:500]))
+
+    ym = (np.digitize(y, [-1.0, 1.0])).astype(np.float64)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "max_bin": 63, "verbose": -1, "seed": 7}
+    ref, st, _, _ = _parity_case(params, X, ym)
+    assert _structure(ref.model_to_string()) == \
+        _structure(st.model_to_string())
+
+
+# ---------------------------------------------------------------------------
+# the acceptance case: >=100k rows under a synthetic HBM cap forcing >=4
+# row blocks, identical trees, peak device bytes below the cap
+
+def test_acceptance_100k_under_fake_hbm_cap(monkeypatch):
+    n, f = 100_000, 10
+    X, y = _reg_data(n, f, seed=9)
+    params = {"objective": "regression", "num_leaves": 8, "max_bin": 63,
+              "verbose": -1, "seed": 7, "tree_grower": "serial"}
+    ds = lgb.Dataset(X, label=y, params=params)
+    ref = lgb.train(params, ds, num_boost_round=3)
+
+    cap = 256 * 1024                       # 256 KB << the 1 MB bin matrix
+    monkeypatch.setenv("STREAM_FAKE_HBM_BYTES", str(cap))
+    ds2 = lgb.Dataset(X, label=y, params=params)
+    ds2.construct()
+    plan = ds2._inner.stream_plan()
+    assert plan is not None and plan.num_blocks >= 4
+    st = lgb.train(params, ds2, num_boost_round=3)
+    from lightgbm_tpu.stream.booster import StreamGBDT
+    assert isinstance(st._gbdt, StreamGBDT)
+
+    assert _structure(ref.model_to_string()) == \
+        _structure(st.model_to_string())
+    np.testing.assert_allclose(st.predict(X[:5000]), ref.predict(X[:5000]),
+                               rtol=0, atol=1e-5)
+    stats = st._gbdt.stream_stats
+    assert stats.peak_block_bytes <= cap
+    assert stats.puts > 0 and stats.passes >= 3 * 8  # >= rounds*(splits+1)
+
+
+# ---------------------------------------------------------------------------
+# data-parallel streaming: 2-rank virtual run (shard-list analog of the
+# multi-process trainer: per-rank block accumulation + cross-shard sum)
+
+def test_two_shard_dp_stream_matches_single(monkeypatch):
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.stream.grower import StreamTreeGrower, make_shards
+    from lightgbm_tpu.utils.random_gen import key_for_iteration
+
+    monkeypatch.setenv("STREAM_FAKE_HBM_BYTES", str(96 * 1024))
+    n, f = 24_000, 8
+    X, y = _reg_data(n, f, seed=5)
+    params = {"objective": "regression", "num_leaves": 15, "max_bin": 63,
+              "verbose": -1, "seed": 7, "tree_grower": "serial"}
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    inner = ds._inner
+    plan = inner.stream_plan()
+    assert plan is not None and plan.num_blocks >= 4
+
+    cfg = Config.from_params(params)
+    tmp = GBDT(cfg)
+    tmp.train_data = inner
+    tmp._dd = inner.device_meta()
+    gcfg = tmp._make_grower_cfg()
+    meta = {k: np.asarray(getattr(tmp._dd, k)) for k in
+            ("num_bins", "default_bins", "nan_bins", "is_categorical",
+             "monotone")}
+
+    import jax.numpy as jnp
+    from lightgbm_tpu.objective import create_objective
+    obj = create_objective(cfg)
+    obj.init(inner.metadata, n)
+    base = obj.boost_from_score(0)
+    g, h = obj.get_gradients(jnp.full(n, base, jnp.float32),
+                             jnp.asarray(inner.metadata.label), None)
+    g = np.asarray(g, np.float32)
+    h = np.asarray(h, np.float32)
+    rw = np.ones(n, np.float32)
+    fmask = np.ones(inner.num_features, np.float32)
+    key = key_for_iteration(cfg.seed, 0, salt=1)
+
+    from lightgbm_tpu.stream.host_matrix import HostBinMatrix
+    bins = inner.bins
+    cut = 13_000                       # deliberately NOT block-aligned
+    single = StreamTreeGrower(
+        make_shards([HostBinMatrix(bins, plan.block_rows)], plan.prefetch),
+        meta, gcfg)
+    t1, a1 = single.grow(g, h, rw, fmask, key)
+
+    two = StreamTreeGrower(
+        make_shards([HostBinMatrix(bins[:cut], plan.block_rows),
+                     HostBinMatrix(bins[cut:], plan.block_rows)],
+                    plan.prefetch),
+        meta, gcfg)
+    t2, a2 = two.grow(g, h, rw, fmask, key)
+
+    assert int(t1.num_leaves) == int(t2.num_leaves)
+    np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+    np.testing.assert_array_equal(t1.threshold, t2.threshold)
+    np.testing.assert_array_equal(t1.left_child, t2.left_child)
+    np.testing.assert_array_equal(t1.right_child, t2.right_child)
+    np.testing.assert_array_equal(a1, a2)      # identical row partition
+    np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                               rtol=1e-5, atol=1e-6)
+
+    # an identity cross_reduce must be a no-op (the multi-process hook)
+    hooked = StreamTreeGrower(
+        make_shards([HostBinMatrix(bins, plan.block_rows)], plan.prefetch),
+        meta, gcfg, cross_reduce=lambda arr: arr)
+    t3, a3 = hooked.grow(g, h, rw, fmask, key)
+    np.testing.assert_array_equal(t1.split_feature, t3.split_feature)
+    np.testing.assert_array_equal(a1, a3)
+
+
+# ---------------------------------------------------------------------------
+# one-liner distributed estimators (ROADMAP 5c)
+
+def test_dist_estimators_single_process():
+    rng = np.random.default_rng(2)
+    n = 5000
+    X = rng.normal(size=(n, 6))
+    yb = np.where(X[:, 0] + 0.2 * rng.normal(size=n) > 0, "pos", "neg")
+    clf = lgb.DistLGBMClassifier(n_estimators=5, num_leaves=7, max_bin=63,
+                                 random_state=3, stream_rows=1024,
+                                 verbose=-1)
+    clf.fit(X, yb, eval_set=[(X[:500], yb[:500])], early_stopping_rounds=3)
+    assert list(clf.classes_) == ["neg", "pos"]
+    assert (clf.predict(X) == yb).mean() > 0.85
+    assert clf.predict_proba(X[:4]).shape == (4, 2)
+
+    yr = X[:, 0] * 2 + 0.1 * rng.normal(size=n)
+    reg = lgb.DistLGBMRegressor(n_estimators=5, num_leaves=7, max_bin=63,
+                                random_state=3, verbose=-1)
+    reg.fit(X, yr)
+    assert reg.score(X, yr) > 0.5
+
+
+# ---------------------------------------------------------------------------
+# guard rails
+
+def test_unsupported_combinations_raise():
+    X, y = _reg_data(3000, 4)
+    for extra in ({"linear_tree": True},
+                  {"boosting": "dart"},
+                  {"monotone_constraints": [1, 0, 0, 0],
+                   "monotone_constraints_method": "intermediate"}):
+        params = {"objective": "regression", "verbose": -1,
+                  "stream_rows": 1024, **extra}
+        with pytest.raises(Exception):
+            ds = lgb.Dataset(X, label=y, params=params)
+            lgb.train(params, ds, num_boost_round=1)

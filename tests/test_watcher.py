@@ -249,10 +249,10 @@ def test_watcher_captures_window_stages_in_order(tmp_path):
     assert p.returncode == 0, p.stderr
     j = _journal(tmp_path)
     assert j["state"] == "done" and j["windows_captured"] == 1
-    assert [s["status"] for s in j["stages"]] == ["ok"] * 5
+    assert [s["status"] for s in j["stages"]] == ["ok"] * 6
     fake = [r["stage"] for r in _perf_records(tmp_path) if r.get("fake")]
     assert fake == ["parity", "perf_suite", "onehot_shootout", "headline",
-                    "bench_serve"]
+                    "bench_serve", "bench_stream"]
     # the headline stage's JSON line is extracted into the watcher record
     head = [r for r in _perf_records(tmp_path)
             if r.get("stage") == "watcher_headline"]
@@ -304,13 +304,15 @@ def test_watcher_stage_crash_degrades_to_remaining(tmp_path):
     j = _journal(tmp_path)
     assert {s["name"]: s["status"] for s in j["stages"]} == {
         "parity": "ok", "perf_suite": "failed",
-        "onehot_shootout": "ok", "headline": "ok", "bench_serve": "ok"}
+        "onehot_shootout": "ok", "headline": "ok", "bench_serve": "ok",
+        "bench_stream": "ok"}
     fail = [r for r in _perf_records(tmp_path)
             if r.get("stage") == "watcher_perf_suite"]
     assert fail and fail[0]["status"] == "crash"
     # the window still completes: later stages ran after the failure
     fake = [r["stage"] for r in _perf_records(tmp_path) if r.get("fake")]
-    assert fake == ["parity", "onehot_shootout", "headline", "bench_serve"]
+    assert fake == ["parity", "onehot_shootout", "headline", "bench_serve",
+                    "bench_stream"]
 
 
 def test_watcher_hung_stage_killed_at_timeout_group_reaped(tmp_path):
@@ -326,7 +328,8 @@ def test_watcher_hung_stage_killed_at_timeout_group_reaped(tmp_path):
     j = _journal(tmp_path)
     assert {s["name"]: s["status"] for s in j["stages"]} == {
         "parity": "ok", "perf_suite": "ok",
-        "onehot_shootout": "failed", "headline": "ok", "bench_serve": "ok"}
+        "onehot_shootout": "failed", "headline": "ok", "bench_serve": "ok",
+        "bench_stream": "ok"}
     rec, = [r for r in _perf_records(tmp_path)
             if r.get("stage") == "watcher_onehot_shootout"]
     assert rec["status"] == "timeout"
@@ -357,7 +360,7 @@ def test_watcher_rewedge_journals_and_resumes(tmp_path):
     # parity ran ONCE: resume did not restart the pipeline
     fake = [r["stage"] for r in _perf_records(tmp_path) if r.get("fake")]
     assert fake == ["parity", "perf_suite", "onehot_shootout", "headline",
-                    "bench_serve"]
+                    "bench_serve", "bench_stream"]
     # the re-wedge itself is journaled to the results log
     wedge, = [r for r in _perf_records(tmp_path)
               if r.get("stage") == "watcher_rewedge"]
@@ -411,7 +414,7 @@ def test_watcher_all_failed_window_not_captured(tmp_path):
     plan.write_text(json.dumps(
         {n: ["crash", "crash"] for n in
          ("parity", "perf_suite", "onehot_shootout", "headline",
-          "bench_serve")}))
+          "bench_serve", "bench_stream")}))
     p = _run_watcher(tmp_path, {"WATCHER_FAKE_BACKEND": "ok",
                                 "WATCHER_FAKE_STAGE_PLAN": str(plan)},
                      args=("--stage-timeout", "5", "--max-polls", "2"))
@@ -437,7 +440,7 @@ def test_watcher_done_journal_rerun_runs_real_window(tmp_path):
         assert p.returncode == 0, p.stderr
     fake = [r["stage"] for r in _perf_records(tmp_path) if r.get("fake")]
     assert fake == ["parity", "perf_suite", "onehot_shootout", "headline",
-                    "bench_serve"] * 2
+                    "bench_serve", "bench_stream"] * 2
     wins = [r for r in _perf_records(tmp_path)
             if r.get("stage") == "watcher_window"]
     assert len(wins) == 2 and all(w["captured"] is True for w in wins)
