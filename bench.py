@@ -56,6 +56,34 @@ def _load_supervise():
     return mod
 
 
+def load_obs():
+    """Load the ``lightgbm_tpu.obs`` telemetry package WITHOUT importing
+    ``lightgbm_tpu`` (whose __init__ pulls in jax) — same motivation as
+    :func:`_load_supervise`.  The obs modules are stdlib-only by design;
+    a synthetic package entry makes their intra-package relative imports
+    (``from .events import ...``) resolve.  Shared by the bench scripts,
+    scripts/tpu_perf_suite.py, and scripts/tpu_window_watcher.py."""
+    import importlib.util
+    if "_lgbtpu_obs" in sys.modules:
+        return sys.modules["_lgbtpu_obs"]
+    pkg_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "lightgbm_tpu", "obs")
+    spec = importlib.util.spec_from_file_location(
+        "_lgbtpu_obs", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    pkg = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = pkg
+    try:
+        spec.loader.exec_module(pkg)
+        # __init__ pulls in events/metrics/tracer; report is the renderer
+        # the watcher uses for per-window artifacts — load it too
+        importlib.import_module(spec.name + ".report")
+    except Exception:
+        del sys.modules[spec.name]
+        raise
+    return pkg
+
+
 _PROBE_CODE = ("import jax, jax.numpy as jnp;"
                "(jnp.ones((64,64)) @ jnp.ones((64,64))).block_until_ready();"
                "print('ndev=%d' % len(jax.devices()))")

@@ -155,8 +155,14 @@ class Application:
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "obs-report":
+        # observability subcommand: render the perf journal + telemetry
+        # snapshot (docs/OBSERVABILITY.md) — not a key=value task
+        from .obs.report import main as obs_report_main
+        return obs_report_main(argv[1:])
     if not argv:
-        print("usage: python -m lightgbm_tpu config=<file> [key=value ...]")
+        print("usage: python -m lightgbm_tpu config=<file> [key=value ...]\n"
+              "       python -m lightgbm_tpu obs-report [--format md|json]")
         return 1
     try:
         Application(parse_argv(argv)).run()

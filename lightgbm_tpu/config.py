@@ -353,6 +353,21 @@ class Config:
     serve_batch_deadline_ms: float = 2.0
     serve_queue_depth: int = 64
 
+    # -- observability (lightgbm_tpu/obs, docs/OBSERVABILITY.md) --
+    # master switch for training-loop telemetry: per-iteration structured
+    # events, phase-seconds metrics and tracer spans.  Off = zero cost
+    # beyond one attribute check per iteration (the <2% overhead budget
+    # is measured by scripts/bench_obs_overhead.py)
+    obs_telemetry: bool = False
+    # event-sink override; "" = the shared journal (WATCHER_PERF_LOG env
+    # var, else the repo-root perf_results.jsonl)
+    obs_events_path: str = ""
+    # also wrap spans in jax.profiler Step/TraceAnnotation so host phases
+    # align with XLA ops when a device trace capture is active
+    obs_trace_device: bool = False
+    # uniform-reservoir size of the rolling-percentile (p50/p99) histograms
+    obs_reservoir_size: int = 512
+
     # unknown keys seen during parsing (kept for model-file round trip)
     _unknown: Dict[str, Any] = field(default_factory=dict, repr=False)
 
@@ -463,6 +478,9 @@ class Config:
             raise LightGBMError("serve_batch_deadline_ms must be >= 0")
         if self.serve_queue_depth < 1:
             raise LightGBMError("serve_queue_depth must be >= 1")
+
+        if self.obs_reservoir_size < 1:
+            raise LightGBMError("obs_reservoir_size must be >= 1")
 
         if self.max_bin_matrix_bytes < 0:
             raise LightGBMError("max_bin_matrix_bytes must be >= 0")

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..config import Config
 from ..io.dataset import Dataset, DeviceData
+from ..obs import TrainTelemetry
 from ..metric import create_metrics
 from ..objective import ObjectiveFunction, create_objective
 from ..ops.grower import GrowerConfig, TreeArrays, grow_tree
@@ -58,6 +59,9 @@ class GBDT:
         self.config = config
         self.train_data: Optional[Dataset] = None
         self.objective = objective
+        # telemetry hook (obs_telemetry): None keeps the off path at one
+        # attribute check per iteration (<2% overhead budget)
+        self._obs = TrainTelemetry(config) if config.obs_telemetry else None
         self._models: List[Tree] = []
         # deferred host trees: (tree_arrays, shrinkage, bias, iter) tuples
         # whose device->host copies are in flight (see `models` property)
@@ -109,6 +113,10 @@ class GBDT:
             arrs, shrink, bias, _it = self._pending.pop(0)
             host = jax.device_get(arrs)
             nl = int(host.num_leaves)
+            if self._obs is not None:
+                self._obs.tree_event(_it, num_leaves=nl, split_gains=[
+                    float(v) for v in
+                    np.asarray(host.split_gain)[:max(0, nl - 1)]])
             tree = Tree.from_arrays(host, self.train_data, learning_rate=1.0)
             tree.shrink(shrink)
             if bias:
@@ -569,6 +577,13 @@ class GBDT:
                         "that meet the split requirements")
             return True
 
+        obs = self._obs
+        if obs is not None:
+            obs.phase_mark()
+            # the global_timer scopes below nest under this span (the
+            # timer->tracer bridge), giving Perfetto the train-loop tree
+            obs.tracer.begin("train/iteration", step=it)
+
         with global_timer.scope("GBDT::gradients"):
             if grad is None or hess is None:
                 g, h = self._compute_gradients(self._train_score)
@@ -605,6 +620,10 @@ class GBDT:
             tree_host = jax.device_get(tree_arrays)
             self._cegb_update(tree_host, node_assign, bag_mask)
             nl = int(tree_host.num_leaves)
+            if obs is not None:
+                obs.tree_event(it, num_leaves=nl, split_gains=[
+                    float(v) for v in
+                    np.asarray(tree_host.split_gain)[:max(0, nl - 1)]])
             if nl > 1:
                 should_stop = False
             tree = Tree.from_arrays(tree_host, self.train_data, learning_rate=1.0)
@@ -670,6 +689,9 @@ class GBDT:
             self._tree_weights.append(self.shrinkage_rate)
 
         self.iter_ += 1
+        if obs is not None:
+            obs.tracer.end("train/iteration")
+            obs.iteration_event(it, trees=K)
         if should_stop:
             Log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
@@ -723,6 +745,12 @@ class GBDT:
             self._device_trees.append(tree_arrays)
             self._tree_weights.append(self.shrinkage_rate)
         self.iter_ += 1
+        if self._obs is not None:
+            # iteration event here, per-tree split-gain events from
+            # _drain_pending when the async host copies land — telemetry
+            # must not add a device sync to the fast path
+            self._obs.tracer.end("train/iteration")
+            self._obs.iteration_event(it, trees=K)
         # keep one iteration in flight: draining then blocks only on the
         # PREVIOUS iteration's device work (host stays a full iteration
         # ahead) and its async device->host copy has typically landed, so

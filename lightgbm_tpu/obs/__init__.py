@@ -1,0 +1,130 @@
+"""Unified telemetry: structured events, metrics, tracing, reporting.
+
+The observability subsystem (docs/OBSERVABILITY.md).  Four layers, all
+stdlib-only so the supervising processes (watcher, perf suite) can load
+them without importing jax:
+
+- :mod:`.events` — versioned structured-event schema + the thread-safe
+  jsonl :class:`~.events.EventLog` behind ``perf_results.jsonl``;
+- :mod:`.metrics` — process-wide counters/gauges/reservoir-percentile
+  histograms, snapshottable on demand;
+- :mod:`.tracer` — nested, thread-safe spans exporting Chrome trace JSON
+  and (optionally) riding ``jax.profiler`` annotations;
+- :mod:`.report` — the ``python -m lightgbm_tpu obs-report`` renderer.
+
+:class:`TrainTelemetry` is the glue the boosting loops hold: one object
+wiring config knobs (``obs_telemetry``, ``obs_events_path``,
+``obs_trace_device``) to an event log, the metrics registry, the global
+tracer, and the ``global_timer`` -> tracer span bridge.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .events import (EventLog, SCHEMA_VERSION, classify_record, make_event,
+                     new_run_id, perf_log_path, validate_event)
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      get_registry)
+from .tracer import Span, Tracer, get_tracer
+
+__all__ = ["EventLog", "SCHEMA_VERSION", "classify_record", "make_event",
+           "new_run_id", "perf_log_path", "validate_event",
+           "Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "get_registry", "Span", "Tracer", "get_tracer",
+           "TrainTelemetry"]
+
+
+class TrainTelemetry:
+    """Per-booster telemetry hook (constructed when ``obs_telemetry`` is
+    on; the boosting loop holds ``None`` otherwise, so the off path costs
+    one attribute check per iteration).
+
+    Wires the config to the subsystem: events go to ``obs_events_path``
+    (default: the shared perf journal), per-iteration seconds feed named
+    histograms in the process registry, and ``global_timer`` scopes are
+    bridged into the global tracer so the existing ``GBDT::*`` /
+    ``StreamGBDT::*`` scopes become nested spans under each iteration's
+    ``train/iteration`` span (with ``jax.profiler`` step annotation when
+    ``obs_trace_device`` is set and a capture is active).
+    """
+
+    #: the global_timer scope names whose per-iteration deltas are
+    #: reported as phase seconds (in-HBM and streaming loops)
+    PHASE_SCOPES = ("GBDT::gradients", "GBDT::grow_tree",
+                    "GBDT::update_score", "StreamGBDT::gradients",
+                    "StreamGBDT::grow_tree", "StreamGBDT::update_score")
+
+    def __init__(self, config: Any, kind: str = "train"):
+        self.kind = kind
+        path = getattr(config, "obs_events_path", "") or None
+        self.log = EventLog(path) if path else EventLog.default()
+        self.run_id = self.log.run_id
+        self.metrics = get_registry()
+        self.reservoir = int(getattr(config, "obs_reservoir_size", 512))
+        self.tracer = get_tracer()
+        self.tracer.annotate_device = bool(
+            getattr(config, "obs_trace_device", False))
+        from ..utils.timer import global_timer
+        self._timer = global_timer
+        global_timer.attach_tracer(self.tracer)
+        self._phase_base: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------
+    def step(self, it: int):
+        """Context for one boosting iteration: a ``train/iteration`` span
+        (StepTraceAnnotation-backed when device tracing is on)."""
+        return self.tracer.step("train/iteration", step=it)
+
+    def phase_mark(self) -> None:
+        """Remember the timer's accumulators at iteration start; the
+        iteration event reports the deltas (the jitted growers are one
+        compiled program, so phase seconds come from the host scopes)."""
+        self._phase_base = {n: self._timer.seconds(n)
+                            for n in self.PHASE_SCOPES}
+
+    def phase_seconds(self) -> Dict[str, float]:
+        out = {}
+        for n in self.PHASE_SCOPES:
+            dt = self._timer.seconds(n) - self._phase_base.get(n, 0.0)
+            if dt > 0.0:
+                short = n.split("::", 1)[-1]
+                out[short] = round(dt, 6)
+        return out
+
+    # ------------------------------------------------------------------
+    def iteration_event(self, it: int, *, trees: int,
+                        extra: Optional[Dict[str, Any]] = None) -> None:
+        """Emit the per-iteration training event + update metrics."""
+        phases = self.phase_seconds()
+        self.metrics.counter(f"{self.kind}.iterations").inc()
+        for name, secs in phases.items():
+            self.metrics.histogram(f"{self.kind}.{name}_seconds",
+                                   self.reservoir).observe(secs)
+        rec: Dict[str, Any] = {"iteration": it, "trees": trees,
+                               "phase_seconds": phases}
+        if extra:
+            rec.update(extra)
+        self.log.emit(f"{self.kind}_iter", **rec)
+
+    def tree_event(self, it: int, *, num_leaves: int,
+                   split_gains: Optional[List[float]] = None) -> None:
+        """Per-materialized-tree stats: leaves + split-gain summary.  On
+        the fast path this fires from ``_drain_pending`` (the existing
+        host materialization point) so telemetry never forces an extra
+        device sync."""
+        self.metrics.histogram(f"{self.kind}.num_leaves",
+                               self.reservoir).observe(num_leaves)
+        rec: Dict[str, Any] = {"iteration": it, "num_leaves": num_leaves}
+        if split_gains:
+            gains = [float(g) for g in split_gains]
+            rec["split_gain"] = {
+                "splits": len(gains),
+                "max": round(max(gains), 6),
+                "mean": round(sum(gains) / len(gains), 6),
+                "total": round(sum(gains), 6)}
+            self.metrics.histogram(f"{self.kind}.split_gain",
+                                   self.reservoir).observe(max(gains))
+        self.log.emit(f"{self.kind}_tree", **rec)
+
+    def close(self) -> None:
+        self._timer.detach_tracer()

@@ -1,0 +1,183 @@
+"""Perf-trajectory report: render the results journal + a metrics snapshot.
+
+``python -m lightgbm_tpu obs-report`` (and the watcher, after each TPU
+window) reads ``perf_results.jsonl`` — schema events and legacy
+pre-schema lines alike — and renders a markdown or JSON report: record
+counts by kind, the headline bench summaries over time, watcher windows,
+and the process's live metrics snapshot when one exists.
+
+Legacy tolerance is the point: the journal predates the schema by many
+sessions, so the loader classifies every line via ``events.classify_record``
+instead of assuming the envelope, and nothing here throws on old shapes.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from .events import classify_record, perf_log_path
+
+__all__ = ["load_perf_log", "summarize", "render_markdown", "render_json",
+           "main"]
+
+
+def load_perf_log(path: Optional[str] = None) -> Dict[str, Any]:
+    """Read + classify every line; missing file -> empty load (a fresh
+    checkout has no journal yet and the report must still render)."""
+    path = path or perf_log_path()
+    events: List[Dict[str, Any]] = []
+    legacy: List[Dict[str, Any]] = []
+    bad = 0
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        lines = []
+    for line in lines:
+        if not line.strip():
+            continue
+        kind, rec = classify_record(line)
+        if kind == "event":
+            events.append(rec)
+        elif kind == "legacy":
+            legacy.append(rec)
+        else:
+            bad += 1
+    return {"path": path, "events": events, "legacy": legacy, "bad": bad,
+            "total": len(events) + len(legacy) + bad}
+
+
+def _stage_of(rec: Dict[str, Any]) -> str:
+    return str(rec.get("event") or rec.get("stage") or rec.get("bench")
+               or rec.get("metric") or "<unkeyed>")
+
+
+def _is_summary(rec: Dict[str, Any]) -> bool:
+    return (rec.get("event") == "bench_summary"
+            or ("metric" in rec and "value" in rec)
+            or "bench" in rec)
+
+
+def summarize(loaded: Dict[str, Any],
+              metrics_snapshot: Optional[Dict[str, Any]] = None,
+              last_n: int = 12) -> Dict[str, Any]:
+    """Aggregate the classified journal into the report's data model."""
+    records = loaded["legacy"] + loaded["events"]
+    by_stage: Dict[str, int] = {}
+    ts_min = ts_max = None
+    for rec in records:
+        by_stage[_stage_of(rec)] = by_stage.get(_stage_of(rec), 0) + 1
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)) and not isinstance(ts, bool):
+            ts_min = ts if ts_min is None else min(ts_min, ts)
+            ts_max = ts if ts_max is None else max(ts_max, ts)
+    summaries = [r for r in records if _is_summary(r)]
+    windows = [r for r in records
+               if _stage_of(r).startswith("watcher_window")]
+    run_ids = sorted({r["run_id"] for r in loaded["events"]})
+    return {
+        "path": loaded["path"],
+        "counts": {"total": loaded["total"],
+                   "schema_events": len(loaded["events"]),
+                   "legacy": len(loaded["legacy"]),
+                   "bad": loaded["bad"]},
+        "runs": len(run_ids),
+        "ts_range": [ts_min, ts_max],
+        "by_stage": dict(sorted(by_stage.items(),
+                                key=lambda kv: (-kv[1], kv[0]))),
+        "recent_summaries": summaries[-last_n:],
+        "windows": windows[-last_n:],
+        "metrics": metrics_snapshot or {},
+    }
+
+
+def _fmt_summary_row(rec: Dict[str, Any]) -> str:
+    metric = rec.get("metric") or rec.get("bench") or rec.get("event")
+    value = rec.get("value")
+    unit = rec.get("unit", "")
+    backend = rec.get("backend", "")
+    val = "" if value is None else (f"{value:g}" if isinstance(
+        value, (int, float)) and not isinstance(value, bool) else str(value))
+    return f"| {metric} | {val} | {unit} | {backend} |"
+
+
+def render_markdown(summary: Dict[str, Any]) -> str:
+    c = summary["counts"]
+    lines = ["# Perf trajectory report", "",
+             f"Journal: `{summary['path']}`", "",
+             f"- records: **{c['total']}** "
+             f"({c['schema_events']} schema event(s), "
+             f"{c['legacy']} legacy line(s), {c['bad']} unparseable)",
+             f"- distinct runs (schema): {summary['runs']}"]
+    ts = summary["ts_range"]
+    if ts[0] is not None:
+        lines.append(f"- wall-clock span: {ts[1] - ts[0]:.0f} s")
+    lines += ["", "## Records by kind", "",
+              "| kind | count |", "|---|---|"]
+    for stage, n in summary["by_stage"].items():
+        lines.append(f"| {stage} | {n} |")
+    if summary["recent_summaries"]:
+        lines += ["", "## Recent bench summaries", "",
+                  "| metric | value | unit | backend |", "|---|---|---|---|"]
+        for rec in summary["recent_summaries"]:
+            lines.append(_fmt_summary_row(rec))
+    if summary["windows"]:
+        lines += ["", "## Watcher windows", ""]
+        for rec in summary["windows"]:
+            wid = rec.get("window_id", "?")
+            lines.append(f"- window `{wid}`: "
+                         + ", ".join(f"{k}={v}" for k, v in rec.items()
+                                     if k not in ("stage", "event", "ts",
+                                                  "mono", "run_id",
+                                                  "schema_version",
+                                                  "window_id")))
+    if summary["metrics"]:
+        lines += ["", "## Telemetry snapshot", "",
+                  "| metric | value |", "|---|---|"]
+        for name, snap in summary["metrics"].items():
+            if snap.get("type") == "histogram" and snap.get("count"):
+                val = (f"n={snap['count']} mean={snap['mean']:.4g} "
+                       f"p50={snap['p50']:.4g} p99={snap['p99']:.4g}")
+            else:
+                val = f"{snap.get('value', 0):g}"
+            lines.append(f"| {name} | {val} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def render_json(summary: Dict[str, Any]) -> str:
+    return json.dumps(summary, indent=2, default=str)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu obs-report",
+        description="render the perf journal + telemetry snapshot")
+    ap.add_argument("--path", default=None,
+                    help="journal to read (default: WATCHER_PERF_LOG or "
+                         "repo perf_results.jsonl)")
+    ap.add_argument("--format", choices=("md", "json"), default="md")
+    ap.add_argument("--out", default=None,
+                    help="write here instead of stdout")
+    ap.add_argument("--no-metrics", action="store_true",
+                    help="omit the in-process metrics snapshot")
+    args = ap.parse_args(argv)
+
+    snap = None
+    if not args.no_metrics:
+        from .metrics import snapshot as _snapshot
+        snap = _snapshot()
+    data = summarize(load_perf_log(args.path), metrics_snapshot=snap)
+    text = render_markdown(data) if args.format == "md" else render_json(data)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

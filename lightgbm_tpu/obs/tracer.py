@@ -1,0 +1,199 @@
+"""Span-based tracing: nested, thread-safe, Chrome-trace exportable.
+
+The upgrade path for ``utils/timer.py``: ``Timer`` keeps its aggregate
+role (name -> total seconds), while an attached :class:`Tracer` records
+every scope as a *span* — begin/end timestamps, thread id, nesting depth
+— so one training run exports a timeline instead of only totals.
+
+- Spans nest per thread (a thread-local open-span stack), so
+  ``train/iteration > GBDT::grow_tree`` renders as nested bars;
+- :meth:`Tracer.export_chrome_trace` writes Chrome trace-event JSON
+  (``ph: "X"`` complete events, microsecond clocks) loadable in Perfetto
+  / ``chrome://tracing``;
+- with ``annotate_device=True`` each span also enters a
+  ``jax.profiler.TraceAnnotation`` (and :meth:`step` a
+  ``StepTraceAnnotation``), so when a ``jax.profiler`` device capture is
+  active the host spans line up with the XLA ops they dispatched — the
+  host/device correlation story for TPU windows.
+
+jax is imported lazily and only when device annotation is requested;
+the module itself is stdlib-only.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Span", "Tracer", "get_tracer"]
+
+
+class Span:
+    """One completed scope."""
+
+    __slots__ = ("name", "start", "duration", "tid", "depth", "args")
+
+    def __init__(self, name: str, start: float, duration: float,
+                 tid: int, depth: int, args: Optional[Dict[str, Any]]):
+        self.name = name
+        self.start = start          # perf_counter seconds
+        self.duration = duration    # seconds
+        self.tid = tid
+        self.depth = depth
+        self.args = args
+
+
+class _OpenSpan:
+    __slots__ = ("name", "start", "args", "annotation")
+
+    def __init__(self, name, start, args, annotation):
+        self.name = name
+        self.start = start
+        self.args = args
+        self.annotation = annotation    # entered jax TraceAnnotation or None
+
+
+class Tracer:
+    """Thread-safe span recorder with bounded memory.
+
+    ``capacity`` bounds retained spans; beyond it new spans are counted in
+    ``dropped`` instead of stored (a tracer must never become the leak it
+    is measuring).
+    """
+
+    def __init__(self, capacity: int = 100_000,
+                 annotate_device: bool = False):
+        self.capacity = int(capacity)
+        self.annotate_device = bool(annotate_device)
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+        self.dropped = 0
+        self._local = threading.local()
+
+    # ------------------------------------------------------------------
+    def _stack(self) -> List[_OpenSpan]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _device_annotation(self, name: str, step: Optional[int] = None):
+        """Enter a jax profiler annotation when asked and available."""
+        if not self.annotate_device:
+            return None
+        try:
+            from jax import profiler as _prof
+            ann = (_prof.StepTraceAnnotation(name, step_num=step)
+                   if step is not None else _prof.TraceAnnotation(name))
+            ann.__enter__()
+            return ann
+        except Exception:
+            return None     # no jax / no profiler: tracing degrades to host
+
+    def begin(self, name: str, step: Optional[int] = None,
+              **args: Any) -> None:
+        """Open a span on the calling thread (pairs with :meth:`end`)."""
+        ann = self._device_annotation(name, step)
+        if step is not None:
+            args = dict(args, step=step)
+        self._stack().append(
+            _OpenSpan(name, time.perf_counter(), args or None, ann))
+
+    def end(self, name: str) -> None:
+        """Close the innermost open span named ``name`` on this thread.
+        Unbalanced ends are ignored (a tracer must not crash its host)."""
+        now = time.perf_counter()
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i].name == name:
+                open_ = stack.pop(i)
+                depth = i
+                break
+        else:
+            return
+        if open_.annotation is not None:
+            try:
+                open_.annotation.__exit__(None, None, None)
+            except Exception:
+                pass
+        span = Span(name, open_.start, now - open_.start,
+                    threading.get_ident(), depth, open_.args)
+        with self._lock:
+            if len(self._spans) < self.capacity:
+                self._spans.append(span)
+            else:
+                self.dropped += 1
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args: Any):
+        self.begin(name, **args)
+        try:
+            yield
+        finally:
+            self.end(name)
+
+    @contextlib.contextmanager
+    def step(self, name: str, step: int):
+        """A top-level per-iteration span; with device annotation on it
+        rides ``jax.profiler.StepTraceAnnotation`` so the profiler groups
+        the iteration's XLA ops under one step."""
+        self.begin(name, step=step)
+        try:
+            yield
+        finally:
+            self.end(name)
+
+    # ------------------------------------------------------------------
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def aggregate(self) -> Dict[str, Dict[str, Any]]:
+        """Per-name totals (the ``Timer.items`` shape, from spans)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for s in self.spans():
+            agg = out.setdefault(s.name, {"count": 0, "total_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += s.duration
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    # ------------------------------------------------------------------
+    def export_chrome_trace(self, path: str) -> int:
+        """Write Chrome trace-event JSON (Perfetto-loadable); returns the
+        number of spans exported."""
+        spans = self.spans()
+        pid = os.getpid()
+        events = []
+        for s in spans:
+            ev: Dict[str, Any] = {
+                "name": s.name, "ph": "X", "pid": pid, "tid": s.tid,
+                "ts": round(s.start * 1e6, 3),
+                "dur": round(s.duration * 1e6, 3),
+            }
+            if s.args:
+                ev["args"] = s.args
+            events.append(ev)
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return len(events)
+
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (what ``global_timer`` feeds when
+    telemetry is on)."""
+    return _TRACER

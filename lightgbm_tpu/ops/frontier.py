@@ -204,6 +204,7 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
         from .grower import monotone_gain_mult
         return monotone_gain_mult(depth, monotone, cfg.monotone_penalty)
 
+    @jax.named_scope("lgbm/split_search")
     def find(hist_fb, sum_g, sum_h, count, fmask=None, rand=None,
              lo=NEG_INF, hi=POS_INF, mult=None):
         fmask = feature_mask if fmask is None else fmask
@@ -349,6 +350,9 @@ def grow_tree_frontier(bins, grad, hess, row_weight, feature_mask,
 
     from .split import leaf_output
 
+    # one named scope per frontier round so device traces show the
+    # per-round cost of the batched partition+hist+search program
+    @jax.named_scope("lgbm/frontier_round")
     def round_body(st):
         applied = st["n_applied"]
         # expansion priority: g_hat primary, RAW gain secondary.  Structural

@@ -531,6 +531,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                         tiled=True)
         return jax.lax.psum(h, axis)
 
+    # lgbm/* named scopes label the phases inside the single fused program
+    # so device traces (jax.profiler / obs_trace_device) decompose the
+    # grower the way the host-paced streaming loop does naturally
+    @jax.named_scope("lgbm/partition")
     def partition_and_hist(perm, begin, rows, feat, thr, dleft, f_is_cat,
                            cbits, ok, left_smaller):
         """One switch over the parent-cap ladder: gather the parent segment's
@@ -610,6 +614,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # pick different buckets, all join here
         return new_perm, nleft, reduce_hist(h)
 
+    @jax.named_scope("lgbm/hist")
     def hist_of(mask, nrows=None):
         def full(m):
             return build_histogram(bins, grad, hess, m, Bb,
@@ -657,6 +662,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             return None
         return monotone_gain_mult(depth, monotone, cfg.monotone_penalty)
 
+    @jax.named_scope("lgbm/split_search")
     def find(hist, sum_g, sum_h, count, fmask, parent_output=0.0,
              lo=NEG_INF, hi=-NEG_INF, penalty=None, rand=None, mult=None):
         """Mode-dispatched best-split search (the analog of the reference's
@@ -930,6 +936,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             res = _reduce_split_global(res, axis)
         return res
 
+    @jax.named_scope("lgbm/apply_split")
     def apply_split(j, st, leaf, gain, ok):
         """Apply the pending best split of ``leaf`` as node ``j``.
 

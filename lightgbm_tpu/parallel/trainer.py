@@ -525,11 +525,24 @@ def _train_distributed_stream(cfg, ds, plan, objective, K, rounds, inits,
             ("num_bins", "default_bins", "nan_bins", "is_categorical",
              "monotone")}
 
+    from ..obs import metrics as obs_metrics
+    _m_calls = obs_metrics.counter("comm.allgather_calls")
+    _m_payload = obs_metrics.counter("comm.payload_bytes")
+    _m_wire = obs_metrics.counter("comm.wire_bytes")
+
     def cross_reduce(arr):
         if nprocs == 1:
             return arr
-        pooled = np.asarray(mhu.process_allgather(np.asarray(arr)))
-        return pooled.reshape((nprocs,) + np.asarray(arr).shape).sum(axis=0)
+        a = np.asarray(arr)
+        # wire-volume ledger: an allgather of P bytes per rank receives
+        # (nprocs - 1) * P remote bytes at this rank (EQuARX-style wire
+        # accounting — counts what crossed the interconnect, not the copy
+        # of our own shard)
+        _m_calls.inc()
+        _m_payload.inc(a.nbytes)
+        _m_wire.inc(a.nbytes * (nprocs - 1))
+        pooled = np.asarray(mhu.process_allgather(a))
+        return pooled.reshape((nprocs,) + a.shape).sum(axis=0)
 
     stats = PipelineStats()
     grower = StreamTreeGrower(
@@ -575,9 +588,15 @@ def _train_distributed_stream(cfg, ds, plan, objective, K, rounds, inits,
             # vector; rank-padded gather keeps the global order, then the
             # shared helper draws the mask over it
             n_max = int(n_locals.max())
-            pooled = np.asarray(mhu.process_allgather(
-                np.pad(imp, (0, n_max - n_local)))).reshape(nprocs, n_max) \
-                if nprocs > 1 else imp[None, :]
+            if nprocs > 1:
+                padded = np.pad(imp, (0, n_max - n_local))
+                _m_calls.inc()
+                _m_payload.inc(padded.nbytes)
+                _m_wire.inc(padded.nbytes * (nprocs - 1))
+                pooled = np.asarray(
+                    mhu.process_allgather(padded)).reshape(nprocs, n_max)
+            else:
+                pooled = imp[None, :]
             imp_g = np.concatenate(
                 [pooled[r, :int(n_locals[r])] for r in range(nprocs)])
             m, a = stream_goss_sample(cfg, it, imp_g, my_off,
@@ -742,8 +761,16 @@ def _pooled_metrics(cfg, objective, vds, vlabel, mhu):
             auc_m.init(md, nkeep)
 
             def auc_ev(vscore, pads=pads, keep=keep, auc_m=auc_m):
+                from ..obs import metrics as obs_metrics
+                padded = pads(vscore[0])
+                import jax as _jax
+                _np = _jax.process_count() - 1
+                obs_metrics.counter("comm.allgather_calls").inc()
+                obs_metrics.counter("comm.payload_bytes").inc(padded.nbytes)
+                obs_metrics.counter("comm.wire_bytes").inc(
+                    padded.nbytes * _np)
                 pooled = np.asarray(mhu.process_allgather(
-                    pads(vscore[0]))).reshape(-1)[keep]
+                    padded)).reshape(-1)[keep]
                 (_, val, _), = auc_m.eval(pooled)
                 return [("auc", float(val))]
             out.append({"name": "auc", "higher_better": True,

@@ -30,6 +30,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from ..utils.log import LightGBMError
 
 __all__ = ["MicroBatcher", "QueueSaturatedError"]
@@ -85,6 +86,15 @@ class MicroBatcher:
         self._broken: Optional[BaseException] = None
         self.stats = {"requests": 0, "batches": 0, "rows": 0,
                       "shed": 0, "max_batch_requests": 0}
+        # process-wide serve metrics (docs/OBSERVABILITY.md): counters
+        # mirror self.stats; the latency/shape histograms have no
+        # per-batcher equivalent and are the online p50-p99 source
+        self._m_requests = obs_metrics.counter("serve.requests")
+        self._m_shed = obs_metrics.counter("serve.shed")
+        self._m_qdepth = obs_metrics.gauge("serve.queue_depth")
+        self._m_batch_rows = obs_metrics.histogram("serve.batch_rows")
+        self._m_batch_reqs = obs_metrics.histogram("serve.batch_requests")
+        self._m_request_ms = obs_metrics.histogram("serve.request_ms")
         self._worker = threading.Thread(
             target=self._loop, name=f"lgbm-serve-batcher-{name}", daemon=True)
         self._worker.start()
@@ -120,6 +130,7 @@ class MicroBatcher:
                 self._q.put_nowait((X, fut))
             except queue.Full:
                 self.stats["shed"] += 1
+                self._m_shed.inc()
                 self._hb("shed", batcher=self.name, pending=self._q.qsize())
                 raise QueueSaturatedError(
                     f"serving queue {self.name!r} saturated "
@@ -127,6 +138,8 @@ class MicroBatcher:
                     "— retry with backoff or raise serve_queue_depth"
                 ) from None
         self.stats["requests"] += 1
+        self._m_requests.inc()
+        self._m_qdepth.set(self._q.qsize())
         if self._broken is not None:
             # the worker may have crashed and run ITS drain between the
             # check at the top and our put; it has exited, so nobody will
@@ -137,8 +150,13 @@ class MicroBatcher:
         return fut
 
     def predict(self, X, timeout: Optional[float] = None) -> np.ndarray:
-        """Synchronous convenience: ``submit`` + wait."""
-        return self.submit(X).result(timeout)
+        """Synchronous convenience: ``submit`` + wait.  The measured span
+        (enqueue -> result) is the caller-observed online latency feeding
+        ``serve.request_ms`` p50-p99."""
+        t0 = time.perf_counter()
+        out = self.submit(X).result(timeout)
+        self._m_request_ms.observe((time.perf_counter() - t0) * 1e3)
+        return out
 
     def close(self, timeout: float = 10.0) -> None:
         """Stop accepting requests, drain what's queued, join the worker."""
@@ -241,6 +259,8 @@ class MicroBatcher:
             self.stats["rows"] += X.shape[0]
             self.stats["max_batch_requests"] = max(
                 self.stats["max_batch_requests"], len(live))
+            self._m_batch_rows.observe(int(X.shape[0]))
+            self._m_batch_reqs.observe(len(live))
             self._hb("batch", batcher=self.name, requests=len(live),
                      rows=int(X.shape[0]))
             out = np.asarray(self._predict(X))

@@ -27,10 +27,12 @@ everything to its only entry.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from ..utils.log import LightGBMError, Log, check
 from .artifact import PredictorArtifact
 from .batcher import MicroBatcher
@@ -232,7 +234,13 @@ class Predictor:
         ent = self._entry(model)
         if ent.batcher is not None and not raw_score:
             return ent.batcher.predict(X, timeout=timeout)
-        return ent.artifact.predict(X, raw_score=raw_score)
+        # direct path (batching off / raw_score): same end-to-end latency
+        # histogram the batched path records in MicroBatcher.predict
+        t0 = time.perf_counter()
+        out = ent.artifact.predict(X, raw_score=raw_score)
+        obs_metrics.histogram("serve.predict_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        return out
 
     def submit(self, X, model: Optional[str] = None):
         """Async submit through the model's micro-batcher."""

@@ -294,6 +294,11 @@ class StreamGBDT(GBDT):
                         "that meet the split requirements")
             return True
 
+        obs = self._obs
+        if obs is not None:
+            obs.phase_mark()
+            obs.tracer.begin("train/iteration", step=it)
+
         with global_timer.scope("StreamGBDT::gradients"):
             if grad is None or hess is None:
                 g, h = self._compute_gradients_stream()
@@ -316,6 +321,11 @@ class StreamGBDT(GBDT):
             nl = int(tree_arrays.num_leaves)
             if nl > 1:
                 should_stop = False
+            if obs is not None:
+                obs.tree_event(
+                    it, num_leaves=nl,
+                    split_gains=[float(v) for v in np.asarray(
+                        tree_arrays.split_gain)[:max(0, nl - 1)]])
             tree = Tree.from_arrays(tree_arrays, self.train_data,
                                     learning_rate=1.0)
 
@@ -350,6 +360,9 @@ class StreamGBDT(GBDT):
             self._tree_weights.append(self.shrinkage_rate)
 
         self.iter_ += 1
+        if obs is not None:
+            obs.tracer.end("train/iteration")
+            obs.iteration_event(it, trees=K)
         if should_stop:
             Log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")

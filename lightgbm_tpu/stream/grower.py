@@ -39,6 +39,7 @@ features/thresholds/structure are asserted exact on tie-free data.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, List, NamedTuple, Optional, Sequence
 
 import numpy as np
@@ -112,6 +113,13 @@ class StreamTreeGrower:
         # per-shard leaf membership, updated incrementally per split
         self._leaf_vecs = [np.zeros(s.matrix.num_data, np.int32)
                            for s in self.shards]
+        # phase histograms (docs/OBSERVABILITY.md): the streamed loop is
+        # host-paced, so these wall-clock spans are genuine per-phase cost
+        # (unlike the fused in-HBM growers, which are one compiled program)
+        from ..obs import metrics as _obs_metrics
+        self._m_hist = _obs_metrics.histogram("stream.hist_seconds")
+        self._m_partition = _obs_metrics.histogram("stream.partition_seconds")
+        self._m_split = _obs_metrics.histogram("stream.split_seconds")
         self._build_jits()
 
     # ------------------------------------------------------------------
@@ -349,7 +357,9 @@ class StreamTreeGrower:
         fmask_dev = jnp.asarray(np.asarray(feature_mask, np.float32))
 
         # ---- root --------------------------------------------------------
+        t0 = time.perf_counter()
         root_hist, tot = self._accumulate_root(g, h, rw)
+        self._m_hist.observe(time.perf_counter() - t0)
         store = jnp.zeros((L, f, self._B, 3), jnp.float32
                           ).at[0].set(jnp.asarray(root_hist))
         leaf_count[0], leaf_weight[0], leaf_sum_g[0] = tot[2], tot[1], tot[0]
@@ -401,10 +411,12 @@ class StreamTreeGrower:
             right_child[j] = ~new_id
 
             # --- streamed partition + smaller-child histogram -------------
+            t0 = time.perf_counter()
             small_local = self._accumulate_split(
                 si_extras, leaf, new_id, feat, thr, dleft, cbits,
                 left_smaller)
             small_hist = jnp.asarray(self._reduce(small_local))
+            self._m_partition.observe(time.perf_counter() - t0)
 
             # --- child bookkeeping (apply_split, host form) ---------------
             depth = leaf_depth[leaf] + 1
@@ -440,6 +452,7 @@ class StreamTreeGrower:
             leaf_lo[new_id], leaf_hi[new_id] = r_lo, r_hi
 
             # --- both children's next best splits (one device sync) -------
+            t0 = time.perf_counter()
             store, s2 = self._child_step(
                 store, small_hist, np.int32(leaf), np.int32(new_id),
                 np.bool_(left_smaller),
@@ -448,6 +461,7 @@ class StreamTreeGrower:
                 jnp.asarray(np.asarray([l_hi, r_hi], np.float32)),
                 np.int32(j + 1), np.int32(depth), fmask_dev, key)
             s2 = jax.device_get(s2)
+            self._m_split.observe(time.perf_counter() - t0)
             depth_ok = cfg.max_depth <= 0 or depth < cfg.max_depth
             sl = jax.tree.map(lambda a: a[0], s2)
             sr = jax.tree.map(lambda a: a[1], s2)

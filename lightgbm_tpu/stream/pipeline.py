@@ -21,6 +21,7 @@ from typing import Dict, Iterator, NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from .host_matrix import HostBinMatrix
 
 
@@ -62,6 +63,13 @@ class RowBlockPipeline:
         self.matrix = matrix
         self.prefetch = max(1, int(prefetch))
         self.stats = stats if stats is not None else PipelineStats()
+        # process-wide mirrors of the per-trainer PipelineStats, so
+        # obs-report sees H2D volume without a handle on the trainer
+        self._m_puts = obs_metrics.counter("stream.h2d_puts")
+        self._m_bytes = obs_metrics.counter("stream.h2d_bytes")
+        self._m_passes = obs_metrics.counter("stream.passes")
+        self._m_skipped = obs_metrics.counter("stream.blocks_skipped")
+        self._m_peak = obs_metrics.gauge("stream.peak_block_bytes")
 
     # ------------------------------------------------------------------
     def _put(self, i: int, extras: Dict[str, np.ndarray]) -> Block:
@@ -86,6 +94,8 @@ class RowBlockPipeline:
         bins_dev = jax.device_put(blk)
         self.stats.puts += 1
         self.stats.bytes_h2d += nbytes
+        self._m_puts.inc()
+        self._m_bytes.inc(nbytes)
         return Block(index=i, rows=rows, start=sl.start, bins=bins_dev,
                      extras=dev_extras)
 
@@ -102,7 +112,9 @@ class RowBlockPipeline:
         order = list(range(m.num_blocks)) if only is None else sorted(only)
         if only is not None:
             self.stats.blocks_skipped += m.num_blocks - len(order)
+            self._m_skipped.inc(m.num_blocks - len(order))
         self.stats.passes += 1
+        self._m_passes.inc()
         q: deque = deque()
         nxt = 0
         first = True
@@ -123,6 +135,7 @@ class RowBlockPipeline:
             held = 0 if first else 1          # the consumer-held block
             self.stats.peak_block_bytes = max(
                 self.stats.peak_block_bytes, (len(q) + held) * per_block)
+            self._m_peak.set_max(self.stats.peak_block_bytes)
             blk = q.popleft()
             first = False
             yield blk

@@ -16,7 +16,6 @@ asymptotic volume; XLA owns the schedule.
 usage: python scripts/bench_dp_scaling.py [rows] [features] [leaves]
 Appends one JSON line per shard count to perf_results.jsonl.
 """
-import json
 import os
 import sys
 import time
@@ -28,7 +27,6 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 import numpy as np   # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PERF_LOG = os.path.join(REPO, "perf_results.jsonl")
 
 rows = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
 feats = int(sys.argv[2]) if len(sys.argv) > 2 else 28
@@ -36,6 +34,12 @@ leaves = int(sys.argv[3]) if len(sys.argv) > 3 else 63
 max_bin = 255
 
 sys.path.insert(0, REPO)
+from bench import load_obs   # noqa: E402
+
+# the single perf-journal writer (obs.events): honors WATCHER_PERF_LOG,
+# which the bare perf_results.jsonl path here previously ignored
+LOG = load_obs().EventLog.default(echo=True)
+
 import lightgbm_tpu as lgb   # noqa: E402
 
 rng = np.random.default_rng(0)
@@ -65,9 +69,10 @@ for ndev in (1, 2, 4, 8):
     print(f"shards={ndev}:  {dt*1e3:8.1f} ms/tree   "
           f"(~{wire_mb:.2f} MB/shard on the wire per split reduce)")
 
-entry = {"bench": "dp_scaling_virtual_mesh", "rows": rows, "features": feats,
-         "leaves": leaves, "max_bin": max_bin, "host_cores": os.cpu_count(),
-         "results": results}
-with open(PERF_LOG, "a") as f:
-    f.write(json.dumps(entry) + "\n")
-print("recorded -> perf_results.jsonl")
+print("recorded -> perf journal")
+# one-JSON-line contract (previously violated here: the last line was
+# prose): summary() appends to the journal AND prints the schema-stamped
+# record as the LAST stdout line
+LOG.summary(bench="dp_scaling_virtual_mesh", rows=rows, features=feats,
+            leaves=leaves, max_bin=max_bin, host_cores=os.cpu_count(),
+            results=results)

@@ -14,17 +14,18 @@ Checks, in order (each emits one JSON line; first failure exits nonzero):
 Run (the ONLY process touching the TPU):
     python scripts/bench_dual.py
 """
-import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from bench import load_obs  # noqa: E402
+
+LOG = load_obs().EventLog.default(echo=True)
+
 
 def emit(**kv):
-    kv["ts"] = time.time()
-    print(json.dumps(kv), flush=True)
+    LOG.emit(kv.pop("stage", "bench_record"), **kv)
 
 
 def main() -> int:
@@ -33,11 +34,16 @@ def main() -> int:
             and "axon" in os.environ.get("JAX_PLATFORMS", "axon")
             and not bench.probe_backend(
                 float(os.environ.get("BENCH_PROBE_TIMEOUT", 300)))):
-        emit(stage="abort", reason="tpu_unreachable")
+        # abort without importing jax (the probe said the TPU would wedge us)
+        LOG.summary(bench="dual_parity", ok=False, reason="tpu_unreachable")
         return 1
     import jax
-    emit(stage="sanity", backend=jax.default_backend())
-    return run_checks(emit)
+    backend = jax.default_backend()
+    emit(stage="sanity", backend=backend)
+    rc = run_checks(emit)
+    # one-JSON-line contract: the LAST stdout line is the schema summary
+    LOG.summary(bench="dual_parity", ok=rc == 0, rc=rc, backend=backend)
+    return rc
 
 
 def run_checks(emit) -> int:

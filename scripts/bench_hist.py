@@ -2,11 +2,19 @@
 
 Run on the TPU (ambient axon backend):  python scripts/bench_hist.py
 """
+import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import load_obs  # noqa: E402
+
+LOG = load_obs().EventLog.default(echo=True)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 
 
 def time_fn(fn, *args, iters=10):
@@ -94,6 +102,7 @@ def main():
     N, F, B = 1_000_000, 28, 256
     bins, g, h, m = make_data(N, F, B)
     ref = None
+    results, failed = {}, 0
     for name, fn, chunk in [
         ("onehot_old c64k", hist_onehot_old, 65536),
         ("onehot_old c8k", hist_onehot_old, 8192),
@@ -113,9 +122,16 @@ def main():
             else:
                 err = float(np.max(np.abs(np.asarray(out) - ref)))
             rows_per_s = N / t
+            results[name] = {"ms": round(t * 1e3, 3), "maxerr": err}
             print(f"{name:20s} {t*1e3:8.2f} ms  {rows_per_s/1e6:8.1f} Mrows/s  maxerr={err:.2e}")
         except Exception as e:
+            failed += 1
             print(f"{name:20s} FAILED: {type(e).__name__} {str(e)[:120]}")
+    best = min(results, key=lambda k: results[k]["ms"]) if results else None
+    # one-JSON-line contract: the LAST stdout line is the schema summary
+    LOG.summary(bench="hist_variants", rows=N, features=F, max_bins=B,
+                backend=jax.default_backend(), ok=len(results), failed=failed,
+                best=best, results=results)
 
 
 if __name__ == "__main__":

@@ -3,14 +3,21 @@
 Run on the TPU (ambient axon backend):
     PYTHONPATH=/root/.axon_site:/root/repo python scripts/bench_hist2.py [rows]
 """
+import os
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from lightgbm_tpu.ops.histogram import _hist_onehot, _hist_pallas
+from bench import load_obs  # noqa: E402
+
+LOG = load_obs().EventLog.default(echo=True)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from lightgbm_tpu.ops.histogram import _hist_onehot, _hist_pallas  # noqa: E402
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
 F = int(sys.argv[2]) if len(sys.argv) > 2 else 28
@@ -50,7 +57,16 @@ err = float(jnp.max(jnp.abs(ref - got) / (jnp.abs(ref) + 1.0)))
 print(f"pallas-vs-onehot max rel err: {err:.2e}")
 assert err < 1e-4, err
 
+results = {}
 for br in (512, 1024, 2048):
-    timed(f"pallas bf16 BR={br}",
-          lambda b, g, h, m, br=br: _hist_pallas(b, g, h, m, B, block_rows=br))
-timed("onehot f32 (xla)", lambda b, g, h, m: _hist_onehot(b, g, h, m, B, 65536))
+    results[f"pallas_bf16_br{br}"] = round(timed(
+        f"pallas bf16 BR={br}",
+        lambda b, g, h, m, br=br: _hist_pallas(b, g, h, m, B, block_rows=br)
+    ) * 1e3, 3)
+results["onehot_f32_xla"] = round(timed(
+    "onehot f32 (xla)",
+    lambda b, g, h, m: _hist_onehot(b, g, h, m, B, 65536)) * 1e3, 3)
+# one-JSON-line contract: the LAST stdout line is the schema summary
+LOG.summary(bench="hist_bf16_parity", rows=N, features=F, max_bins=B,
+            backend=jax.default_backend(), parity_relerr=err,
+            results_ms=results)

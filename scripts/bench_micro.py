@@ -5,11 +5,19 @@ cumsum, and the pallas histogram at ladder cap sizes.
 
 usage: PYTHONPATH=/root/.axon_site:/root/repo python scripts/bench_micro.py
 """
+import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import load_obs  # noqa: E402
+
+LOG = load_obs().EventLog.default(echo=True)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 
 N, F, B = 1_000_000, 28, 256
 rng = np.random.default_rng(0)
@@ -17,6 +25,9 @@ bins = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
 bins_t = jnp.asarray(np.asarray(bins).T.copy())
 g = jnp.asarray(rng.normal(size=N).astype(np.float32))
 h = jnp.asarray(np.full(N, 0.25, np.float32))
+
+
+RESULTS_MS = {}
 
 
 def timed(name, fn, *args, iters=20):
@@ -27,6 +38,7 @@ def timed(name, fn, *args, iters=20):
     jax.block_until_ready(r)
     dt = (time.perf_counter() - t0) / iters
     print(f"{name:44s} {dt*1e3:8.3f} ms")
+    RESULTS_MS[name] = round(dt * 1e3, 4)
     return dt
 
 
@@ -81,3 +93,8 @@ for cap in (16384, 131072, 524288):
     mono = jnp.sort(seg)
     timed(f"gather comb rows SORTED idx [cap={cap}]",
           jax.jit(lambda s: jnp.take(comb, s, axis=0)), mono)
+
+# one-JSON-line contract: the LAST stdout line is the schema summary
+LOG.summary(bench="micro_primitives", rows=N, features=F, max_bins=B,
+            backend=jax.default_backend(), entries=len(RESULTS_MS),
+            results_ms=RESULTS_MS)

@@ -23,25 +23,21 @@ width) and 64 (exercising the lane-packing variant).  The watcher's
 onehot_shootout stage runs this unchanged.
 """
 import argparse
-import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# the watcher points every stage at one results file; standalone runs use
-# the repo default
-OUT = os.environ.get("WATCHER_PERF_LOG") or os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "perf_results.jsonl")
+from bench import load_obs  # noqa: E402
+
+# the watcher points every stage at one results file (WATCHER_PERF_LOG);
+# obs.events owns that resolution now — one writer for every bench
+LOG = load_obs().EventLog.default(echo=True)
 
 
 def emit(**kv):
-    kv["ts"] = time.time()
-    with open(OUT, "a") as f:
-        f.write(json.dumps(kv) + "\n")
-    print(json.dumps(kv), flush=True)
+    LOG.emit(kv.pop("stage", "bench_record"), **kv)
 
 
 # (variant, BR) grid: every registry family at the production BR, plus a
@@ -76,6 +72,7 @@ def run_shootout(rows, max_bins, emit=emit, interpret=False):
     # because ONE experimental variant refused to lower, discarding every
     # valid timing already captured.  Nonzero is reserved for the sweep
     # itself crashing (main's probe abort / an unhandled error).
+    tally = {"ok": 0, "failed": 0, "skipped": 0, "best": None}
     for B in max_bins:
         rng = np.random.default_rng(0)
         # pad rows to a multiple of the largest BR so every entry divides
@@ -99,6 +96,7 @@ def run_shootout(rows, max_bins, emit=emit, interpret=False):
             if not spec.supports(B):
                 emit(stage="onehot_variant", name=tag, max_bin=B,
                      skipped="unsupported_max_bin")
+                tally["skipped"] += 1
                 continue
             try:
                 prep, run = ov.make_bench_kernel(name, F, B, BR,
@@ -111,6 +109,7 @@ def run_shootout(rows, max_bins, emit=emit, interpret=False):
                 if err > HIST_PARITY_TOL:
                     emit(stage="onehot_variant", name=tag, max_bin=B,
                          ok=False, relerr=err)
+                    tally["failed"] += 1
                     continue
                 t0 = time.perf_counter()
                 for _ in range(10):
@@ -126,10 +125,16 @@ def run_shootout(rows, max_bins, emit=emit, interpret=False):
                      mfu=round(2.0 * 6 * rows * lanes / dt / peak, 4),
                      mxu_lanes=lanes,
                      onehot_elems_per_row=spec.vpu_compares(F, B, 1))
+                tally["ok"] += 1
+                if (tally["best"] is None
+                        or dt * 1e3 < tally["best"]["ms"]):
+                    tally["best"] = {"name": tag, "max_bin": B,
+                                     "ms": round(dt * 1e3, 3)}
             except Exception as e:
                 emit(stage="onehot_variant", name=tag, max_bin=B, ok=False,
                      error=str(e)[:250])
-    return 0
+                tally["failed"] += 1
+    return tally
 
 
 def parse_args(argv):
@@ -152,8 +157,14 @@ def main(argv=None):
         emit(stage="abort", reason="tpu_unreachable")
         return 1
 
-    return run_shootout(args.rows, max_bins,
-                        interpret=bool(os.environ.get("ONEHOT_INTERPRET")))
+    tally = run_shootout(args.rows, max_bins,
+                         interpret=bool(os.environ.get("ONEHOT_INTERPRET")))
+    # one-JSON-line contract: summary() appends to the journal AND prints
+    # the schema-stamped record as the LAST stdout line.  Per-entry
+    # failures are informational (see run_shootout) — exit 0 regardless.
+    LOG.summary(bench="onehot_variants", rows=args.rows, max_bins=max_bins,
+                **tally)
+    return 0
 
 
 if __name__ == "__main__":

@@ -23,7 +23,6 @@ Run:
                                   [--iters 10] [--quick]
 """
 import argparse
-import json
 import os
 import sys
 import time
@@ -31,15 +30,16 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-OUT = os.environ.get("WATCHER_PERF_LOG") or os.path.join(
-    REPO, "perf_results.jsonl")
+from bench import load_obs  # noqa: E402
+
+# the single perf-journal writer (obs.events resolves WATCHER_PERF_LOG or
+# the repo default).  Loaded WITHOUT lightgbm_tpu/jax: the serve_abort
+# record must land even when importing jax would wedge the process.
+LOG = load_obs().EventLog.default(echo=True)
 
 
 def emit(**kv):
-    kv["ts"] = time.time()
-    with open(OUT, "a") as f:
-        f.write(json.dumps(kv) + "\n")
-    print(json.dumps(kv), flush=True)
+    LOG.emit(kv.pop("stage", "bench_record"), **kv)
 
 
 def _pctl(xs, q):
@@ -211,17 +211,17 @@ def main(argv=None) -> int:
         deadline_ms=bst._gbdt.config.serve_batch_deadline_ms,
         queue_depth=bst._gbdt.config.serve_queue_depth)
 
-    # one-JSON-line contract: the LAST stdout line is the summary
-    print(json.dumps({
-        "metric": "serve_throughput", "unit": "rows/sec",
-        "value": round(max(direct_rps, batched_rps), 1),
-        "backend": backend,
-        "detail": {"direct_rows_per_sec": round(direct_rps, 1),
-                   "batched_rows_per_sec": round(batched_rps, 1),
-                   "trees": args.trees, "feats": args.feats,
-                   "buckets": buckets,
-                   "aot_compile_secs": round(compile_secs, 2)}}),
-        flush=True)
+    # one-JSON-line contract: summary() appends to the journal AND prints
+    # the schema-stamped record as the LAST stdout line
+    LOG.summary(
+        metric="serve_throughput", unit="rows/sec",
+        value=round(max(direct_rps, batched_rps), 1),
+        backend=backend,
+        detail={"direct_rows_per_sec": round(direct_rps, 1),
+                "batched_rows_per_sec": round(batched_rps, 1),
+                "trees": args.trees, "feats": args.feats,
+                "buckets": buckets,
+                "aot_compile_secs": round(compile_secs, 2)})
     return 0
 
 

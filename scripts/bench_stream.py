@@ -26,7 +26,6 @@ Run:
                                    [--rounds 5] [--quick]
 """
 import argparse
-import json
 import os
 import sys
 import time
@@ -34,15 +33,15 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-OUT = os.environ.get("WATCHER_PERF_LOG") or os.path.join(
-    REPO, "perf_results.jsonl")
+from bench import load_obs  # noqa: E402
+
+# the single perf-journal writer (obs.events resolves WATCHER_PERF_LOG or
+# the repo default); echo keeps the one-record-per-line stdout mirror
+LOG = load_obs().EventLog.default(echo=True)
 
 
 def emit(**kv):
-    kv["ts"] = time.time()
-    with open(OUT, "a") as f:
-        f.write(json.dumps(kv) + "\n")
-    print(json.dumps(kv), flush=True)
+    LOG.emit(kv.pop("stage", "bench_record"), **kv)
 
 
 def make_data(rows: int, feats: int):
@@ -171,7 +170,9 @@ def main() -> int:
                            for r in results],
                    prefetch_speedup=round(times[1] / times[2], 4),
                    ok=bool(ok))
-    print(json.dumps(summary), flush=True)
+    # one-JSON-line contract: summary() appends to the journal AND prints
+    # the schema-stamped record as the LAST stdout line
+    LOG.summary(**summary)
     return 0 if ok else 1
 
 

@@ -33,9 +33,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 from bench import make_higgs_like  # noqa: E402
 
+from bench import load_obs  # noqa: E402
+
 REF_BIN = os.environ.get("REF_LGBM_BIN", "/tmp/lgbm_src/lightgbm")
 OUT_JSON = os.path.join(REPO, "docs", "ref_headtohead.json")
-PERF_LOG = os.path.join(REPO, "perf_results.jsonl")
+# the single perf-journal writer (obs.events): honors WATCHER_PERF_LOG,
+# which the bare perf_results.jsonl path here previously ignored
+LOG = load_obs().EventLog.default(echo=True)
 
 # one row per line, label first (the reference default: label=column 0).
 # %.9g round-trips float32 bit-exactly (9 significant digits uniquely
@@ -153,9 +157,10 @@ def main() -> None:
     os.makedirs(os.path.dirname(OUT_JSON), exist_ok=True)
     with open(OUT_JSON, "w") as f:
         json.dump(table, f, indent=1)
-    with open(PERF_LOG, "a") as f:
-        f.write(json.dumps({"bench": "ref_headtohead", **entry}) + "\n")
     print(f"recorded -> {OUT_JSON}")
+    # one-JSON-line contract: summary() appends to the journal AND prints
+    # the schema-stamped record as the LAST stdout line
+    LOG.summary(bench="ref_headtohead", **entry)
 
 
 if __name__ == "__main__":

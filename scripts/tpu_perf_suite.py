@@ -35,11 +35,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# the watcher points every stage at one results file; standalone runs use
-# the repo default
-OUT = os.environ.get("WATCHER_PERF_LOG") or os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "perf_results.jsonl")
+from bench import load_obs  # noqa: E402
+
+# the watcher points every stage at one results file (WATCHER_PERF_LOG);
+# obs.events owns that resolution now — one writer for every bench.
+# Loaded WITHOUT lightgbm_tpu/jax: the suite supervises subprocesses and
+# must never touch a possibly-wedged backend itself.
+LOG = load_obs().EventLog.default(echo=True)
+OUT = LOG.path
 ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
 
 PHASES = ("sanity", "parity", "hist_micro", "grow_sweep",
@@ -47,10 +50,7 @@ PHASES = ("sanity", "parity", "hist_micro", "grow_sweep",
 
 
 def emit(**kv):
-    kv["ts"] = time.time()
-    with open(OUT, "a") as f:
-        f.write(json.dumps(kv) + "\n")
-    print(json.dumps(kv), flush=True)
+    LOG.emit(kv.pop("stage", "suite_record"), **kv)
 
 
 class SuiteAbort(RuntimeError):

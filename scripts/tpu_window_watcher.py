@@ -380,6 +380,26 @@ def finish_window(args, j: dict, hb) -> None:
     captured = any(v == "ok" for v in statuses.values())
     _append_perf({"stage": "watcher_window", "window_id": j["window_id"],
                   "stages": statuses, "captured": captured})
+    # per-window observability artifact: render the perf journal through
+    # obs.report (jax-free loader — this process must never touch the
+    # backend) into a markdown digest beside the log.  Never fatal: a
+    # render bug must not cost the captured window.
+    try:
+        report = bench.load_obs().report
+        loaded = report.load_perf_log(_perf_log_path())
+        md = report.render_markdown(report.summarize(loaded))
+        art_path = os.path.join(
+            os.path.dirname(os.path.abspath(_perf_log_path())),
+            f"obs_report_window_{j['window_id']}.md")
+        with open(art_path, "w") as f:
+            f.write(md)
+        _append_perf({"stage": "watcher_obs_report",
+                      "window_id": j["window_id"], "path": art_path,
+                      "events": loaded["total"], "bad": loaded["bad"]})
+    except Exception as e:
+        _append_perf({"stage": "watcher_obs_report",
+                      "window_id": j["window_id"],
+                      "error": f"{type(e).__name__}: {e}"[:300]})
     hb("window_complete", window_id=j["window_id"], stages=statuses,
        captured=captured)
     if captured:

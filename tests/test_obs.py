@@ -1,0 +1,511 @@
+"""Observability-subsystem tests (lightgbm_tpu/obs, docs/OBSERVABILITY.md).
+
+CPU-only and fast.  Covers ISSUE 16's acceptance criteria: the structured
+event schema round-trips and is thread-safe; the report layer tolerates
+the legacy (pre-schema) journal lines the six old writers produced; the
+serve-path metrics are correct under concurrent load; a CPU training run
+emits one schema-valid event per boosting iteration and exports a Chrome
+trace with nested spans; and every ``scripts/bench_*.py`` is statically
+held to the one-JSON-line summary contract.
+"""
+import glob
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.obs import (EventLog, SCHEMA_VERSION, classify_record,
+                              make_event, new_run_id, validate_event)
+from lightgbm_tpu.obs import metrics as obs_metrics
+from lightgbm_tpu.obs import report as obs_report
+from lightgbm_tpu.obs.events import SUMMARY_EVENT, perf_log_path
+from lightgbm_tpu.obs.tracer import Tracer, get_tracer
+from lightgbm_tpu.utils.timer import Timer, global_timer
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# events: schema round-trip, classification, EventLog
+def test_make_event_envelope_and_validate():
+    rec = make_event("train_iter", new_run_id(), iteration=3, trees=1)
+    assert validate_event(rec) == []
+    assert rec["schema_version"] == SCHEMA_VERSION
+    assert rec["event"] == "train_iter"
+    assert rec["stage"] == "train_iter"      # legacy-reader mirror
+    assert rec["iteration"] == 3
+    # envelope keys are reserved: caller values must not survive
+    rec2 = make_event("x", "rid", schema_version=99, ts="forged")
+    assert rec2["schema_version"] == SCHEMA_VERSION
+    assert isinstance(rec2["ts"], float)
+    assert validate_event(rec2) == []
+    # a caller-carried stage wins over the mirror
+    rec3 = make_event("bench_record", "rid", stage="train_stream")
+    assert rec3["stage"] == "train_stream"
+
+
+def test_validate_event_rejects_malformed():
+    assert validate_event("not a dict")
+    assert validate_event({"event": "x"})                 # missing envelope
+    bad = make_event("x", new_run_id())
+    bad["ts"] = "noon"
+    assert any("ts" in e for e in validate_event(bad))
+    bad2 = make_event("x", new_run_id())
+    bad2["run_id"] = ""
+    assert any("run_id" in e for e in validate_event(bad2))
+
+
+def test_classify_record_three_kinds():
+    ev = make_event("suite_record", new_run_id())
+    assert classify_record(json.dumps(ev))[0] == "event"
+    # pre-schema writer shapes from the repo journal
+    kind, rec = classify_record('{"stage": "bench_stream", "ok": true}')
+    assert kind == "legacy" and rec["stage"] == "bench_stream"
+    assert classify_record("not json {")[0] == "bad"
+    assert classify_record("[1, 2]")[0] == "bad"
+    assert classify_record("")[0] == "bad"
+    # schema-stamped but invalid: classified bad, record still returned
+    forged = dict(ev, run_id=7)
+    assert classify_record(json.dumps(forged))[0] == "bad"
+
+
+def test_eventlog_round_trip_and_summary_contract(tmp_path, capsys):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, echo=True)
+    log.emit("suite_record", phase="hist", ms=1.5)
+    log.summary(metric="throughput", unit="rows/sec", value=1e6)
+    out = capsys.readouterr().out.strip().splitlines()
+    # echo printed both; the summary is the LAST stdout line and is valid
+    last = json.loads(out[-1])
+    assert last["event"] == SUMMARY_EVENT and validate_event(last) == []
+    with open(path) as f:
+        lines = f.readlines()
+    assert len(lines) == 2
+    kinds = [classify_record(ln) for ln in lines]
+    assert [k for k, _ in kinds] == ["event", "event"]
+    assert kinds[0][1]["phase"] == "hist"
+    # one run_id correlates every record of the log
+    assert kinds[0][1]["run_id"] == kinds[1][1]["run_id"] == log.run_id
+
+
+def test_eventlog_summary_refuses_unserializable(tmp_path):
+    log = EventLog(str(tmp_path / "e.jsonl"))
+    with pytest.raises(TypeError):
+        log.summary(metric="x", value=object())   # fails loudly, not later
+    assert not os.path.exists(log.path) or not open(log.path).read()
+
+
+def test_eventlog_default_honors_watcher_perf_log(tmp_path, monkeypatch):
+    target = str(tmp_path / "window" / "perf.jsonl")
+    monkeypatch.setenv("WATCHER_PERF_LOG", target)
+    assert perf_log_path() == target
+    log = EventLog.default()
+    assert log.path == target
+    assert EventLog.default() is log          # one default per path
+    log.emit("watcher_probe", ok=True)        # creates parent dirs
+    assert classify_record(open(target).read())[0] == "event"
+
+
+def test_eventlog_concurrent_writers_interleave_whole_lines(tmp_path):
+    path = str(tmp_path / "c.jsonl")
+    log = EventLog(path)
+    n_threads, n_each = 8, 50
+
+    def writer(i):
+        for j in range(n_each):
+            log.emit("stress", thread=i, seq=j)
+
+    ts = [threading.Thread(target=writer, args=(i,)) for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    with open(path) as f:
+        recs = [classify_record(ln) for ln in f]
+    assert len(recs) == n_threads * n_each
+    assert all(k == "event" for k, _ in recs)   # no torn/fragmented lines
+    seen = {(r["thread"], r["seq"]) for _, r in recs}
+    assert len(seen) == n_threads * n_each
+
+
+# ---------------------------------------------------------------------------
+# report: legacy tolerance, rendering
+def test_report_tolerates_mixed_journal(tmp_path):
+    path = str(tmp_path / "perf.jsonl")
+    rid = new_run_id()
+    with open(path, "w") as f:
+        f.write('{"stage": "bench_stream", "rows": 100, "ok": true}\n')
+        f.write('{"metric": "serve_throughput", "value": 5.0, '
+                '"unit": "rows/sec"}\n')
+        f.write("garbage line\n")
+        f.write("\n")                                     # blanks skipped
+        f.write(json.dumps(make_event("train_iter", rid, iteration=0)) + "\n")
+        f.write(json.dumps(make_event(SUMMARY_EVENT, rid, metric="m",
+                                      value=1)) + "\n")
+    loaded = obs_report.load_perf_log(path)
+    assert loaded["total"] == 5                           # blank not counted
+    assert len(loaded["events"]) == 2
+    assert len(loaded["legacy"]) == 2
+    assert loaded["bad"] == 1
+    summ = obs_report.summarize(loaded)
+    assert summ["counts"] == {"total": 5, "schema_events": 2, "legacy": 2,
+                              "bad": 1}
+    assert summ["runs"] == 1
+    assert summ["by_stage"]["bench_stream"] == 1
+    # legacy metric-style line and the schema summary both count as results
+    assert len(summ["recent_summaries"]) == 2
+    md = obs_report.render_markdown(summ)
+    assert "bench_stream" in md and "train_iter" in md
+    json.loads(obs_report.render_json(summ))              # valid JSON
+
+
+def test_report_renders_repo_journal_and_missing_file(tmp_path):
+    # the real pre-subsystem journal: every line must classify, none lost
+    repo_journal = os.path.join(REPO, "perf_results.jsonl")
+    if os.path.exists(repo_journal):
+        loaded = obs_report.load_perf_log(repo_journal)
+        with open(repo_journal) as f:
+            n_lines = sum(1 for ln in f if ln.strip())
+        assert loaded["total"] == n_lines
+        assert loaded["bad"] == 0
+        obs_report.render_markdown(obs_report.summarize(loaded))
+    # a fresh checkout has no journal: report still renders
+    empty = obs_report.load_perf_log(str(tmp_path / "absent.jsonl"))
+    assert empty["total"] == 0
+    md = obs_report.render_markdown(obs_report.summarize(empty))
+    assert md
+
+
+def test_obs_report_cli(tmp_path, capsys):
+    path = str(tmp_path / "p.jsonl")
+    EventLog(path).emit("train_iter", iteration=0)
+    assert obs_report.main(["--path", path, "--format", "json",
+                            "--no-metrics"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["counts"]["schema_events"] == 1
+    out_md = str(tmp_path / "report.md")
+    assert obs_report.main(["--path", path, "--out", out_md]) == 0
+    assert "train_iter" in open(out_md).read()
+
+
+# ---------------------------------------------------------------------------
+# metrics: registry semantics + concurrency
+def test_metrics_registry_types_and_reset():
+    obs_metrics.reset()
+    c = obs_metrics.counter("t.count")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    g = obs_metrics.gauge("t.depth")
+    g.set(3.0)
+    g.set_max(2.0)        # lower: keeps max
+    g.set_max(7.0)
+    assert g.value == 7.0
+    with pytest.raises(TypeError):
+        obs_metrics.gauge("t.count")       # name registered as a counter
+    snap = obs_metrics.snapshot()
+    assert snap["t.count"] == {"type": "counter", "value": 5}
+    obs_metrics.reset()
+    assert obs_metrics.counter("t.count").value == 0
+
+
+def test_histogram_percentiles_exact_then_sampled():
+    h = obs_metrics.Histogram("h", reservoir_size=1000)
+    for v in range(100):                   # below reservoir: exact
+        h.observe(float(v))
+    assert h.count == 100
+    snap = h.snapshot()
+    assert snap["min"] == 0.0 and snap["max"] == 99.0
+    assert snap["p50"] == pytest.approx(50.0, abs=1)
+    assert snap["p99"] == pytest.approx(98.0, abs=1)
+    # beyond the reservoir the percentiles stay statistically sane
+    small = obs_metrics.Histogram("s", reservoir_size=64)
+    for v in range(10_000):
+        small.observe(float(v % 1000))
+    assert small.count == 10_000
+    assert 200.0 <= small.snapshot()["p50"] <= 800.0
+
+
+def test_counters_thread_safe():
+    c = obs_metrics.Counter("race")
+    n_threads, n_each = 8, 2000
+
+    def bump():
+        for _ in range(n_each):
+            c.inc()
+
+    ts = [threading.Thread(target=bump) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value == n_threads * n_each
+
+
+# ---------------------------------------------------------------------------
+# serve-path metrics under concurrent load
+def test_batcher_metrics_under_concurrent_load():
+    from lightgbm_tpu.serve import MicroBatcher
+
+    obs_metrics.reset()
+    mb = MicroBatcher(lambda xb: xb[:, 0] * 2.0, max_batch_rows=64,
+                      deadline_ms=2.0, queue_depth=256, name="obs")
+    n_threads, n_each = 4, 20
+    errs = []
+
+    def client(i):
+        rng = np.random.default_rng(i)
+        for _ in range(n_each):
+            x = rng.normal(size=(3, 5))
+            try:
+                out = mb.predict(x, timeout=30)
+                assert np.array_equal(out, x[:, 0] * 2.0)
+            except Exception as e:      # pragma: no cover - diagnostic
+                errs.append(e)
+
+    try:
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        mb.close()
+    assert not errs
+    total = n_threads * n_each
+    snap = obs_metrics.snapshot()
+    assert snap["serve.requests"]["value"] == total
+    assert snap["serve.shed"]["value"] == 0
+    lat = snap["serve.request_ms"]
+    assert lat["count"] == total
+    assert 0.0 < lat["p50"] <= lat["p99"]       # online p50-p99 populated
+    rows = snap["serve.batch_rows"]
+    assert rows["count"] >= 1
+    # coalescing conserves rows: batched rows == submitted rows
+    assert rows["sum"] == pytest.approx(total * 3)
+    assert snap["serve.batch_requests"]["max"] <= 64 / 3 + 1
+
+
+def test_batcher_shed_metric():
+    from lightgbm_tpu.serve import MicroBatcher, QueueSaturatedError
+
+    obs_metrics.reset()
+    release = threading.Event()
+    mb = MicroBatcher(lambda xb: (release.wait(10), np.zeros(xb.shape[0]))[1],
+                      max_batch_rows=1, deadline_ms=0.0, queue_depth=1,
+                      name="shed")
+    try:
+        first = mb.submit(np.zeros((1, 2)))   # worker blocks inside predict
+        import time as _time
+        _time.sleep(0.1)
+        pend = mb.submit(np.zeros((1, 2)))    # queue now full
+        with pytest.raises(QueueSaturatedError):
+            mb.submit(np.zeros((1, 2)))
+        release.set()
+        first.result(10)
+        pend.result(10)
+    finally:
+        release.set()
+        mb.close()
+    snap = obs_metrics.snapshot()
+    assert snap["serve.shed"]["value"] == 1
+    assert snap["serve.requests"]["value"] == 2   # shed request not counted
+
+
+# ---------------------------------------------------------------------------
+# tracer + timer bridge
+def test_tracer_nested_spans_and_chrome_export(tmp_path):
+    tr = Tracer()
+    with tr.span("outer"):
+        with tr.span("inner", leaf=3):
+            pass
+        with tr.span("inner"):
+            pass
+    spans = tr.spans()
+    assert [s.name for s in spans] == ["inner", "inner", "outer"]
+    assert [s.depth for s in spans] == [1, 1, 0]
+    assert spans[0].args == {"leaf": 3}
+    agg = tr.aggregate()
+    assert agg["inner"]["count"] == 2
+    out = str(tmp_path / "trace.json")
+    assert tr.export_chrome_trace(out) == 3
+    doc = json.load(open(out))
+    assert {e["ph"] for e in doc["traceEvents"]} == {"X"}
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert names.count("inner") == 2 and "outer" in names
+
+
+def test_tracer_unbalanced_end_is_ignored_and_capacity_bounds():
+    tr = Tracer(capacity=2)
+    tr.end("never-opened")                      # must not raise
+    for i in range(4):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr.spans()) == 2 and tr.dropped == 2
+    tr.reset()
+    assert tr.spans() == [] and tr.dropped == 0
+
+
+def test_tracer_threads_get_independent_stacks():
+    tr = Tracer()
+    barrier = threading.Barrier(2)
+
+    def worker(i):
+        with tr.span("work", who=i):
+            barrier.wait(5)                     # both spans open at once
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    spans = tr.spans()
+    assert len(spans) == 2
+    assert spans[0].tid != spans[1].tid
+    assert all(s.depth == 0 for s in spans)     # no cross-thread nesting
+
+
+def test_timer_bridge_mirrors_scopes_into_tracer():
+    timer = Timer()
+    tr = Tracer()
+    timer.attach_tracer(tr)
+    with timer.scope("GBDT::grow_tree"):
+        with timer.scope("GBDT::grow_tree"):    # same name may nest
+            pass
+    timer.detach_tracer()
+    with timer.scope("GBDT::grow_tree"):        # detached: no span
+        pass
+    assert timer.calls("GBDT::grow_tree") == 3
+    assert timer.seconds("GBDT::grow_tree") > 0.0
+    spans = tr.spans()
+    assert len(spans) == 2
+    assert {s.depth for s in spans} == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# boosting loop: per-iteration events + nested training trace
+@pytest.fixture
+def train_telemetry_env(tmp_path):
+    """Isolated event sink + clean global tracer/timer around one run."""
+    path = str(tmp_path / "train_events.jsonl")
+    obs_metrics.reset()
+    get_tracer().reset()
+    global_timer.reset()
+    yield path
+    global_timer.detach_tracer()
+    get_tracer().reset()
+
+
+def test_training_emits_one_event_per_iteration(train_telemetry_env, tmp_path):
+    import lightgbm_tpu as lgb
+
+    path = train_telemetry_env
+    rounds = 5
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.1 * rng.normal(size=400))
+    p = {"objective": "regression", "num_leaves": 7, "verbose": -1,
+         "obs_telemetry": True, "obs_events_path": path}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=rounds)
+    bst.predict(X[:10])                   # materialize pending host trees
+    with open(path) as f:
+        recs = [classify_record(ln) for ln in f]
+    assert all(k == "event" for k, _ in recs)
+    iters = [r for _, r in recs if r["event"] == "train_iter"]
+    trees = [r for _, r in recs if r["event"] == "train_tree"]
+    assert len(iters) == rounds           # exactly one per boosting round
+    assert [r["iteration"] for r in iters] == list(range(rounds))
+    assert len({r["run_id"] for _, r in recs}) == 1
+    # phase seconds cover the boosting loop's three phases
+    assert set(iters[0]["phase_seconds"]) == {"gradients", "grow_tree",
+                                              "update_score"}
+    # per-tree stats landed via the async drain (no forced sync)
+    assert len(trees) >= rounds - 1
+    assert all(t["num_leaves"] >= 2 for t in trees)
+    assert all(t["split_gain"]["splits"] == t["num_leaves"] - 1
+               for t in trees)
+    # metrics registry mirrors the stream
+    snap = obs_metrics.snapshot()
+    assert snap["train.iterations"]["value"] == rounds
+    assert snap["train.grow_tree_seconds"]["count"] == rounds
+    assert snap["train.num_leaves"]["count"] == len(trees)
+    # the global tracer holds nested spans: timer scopes under the
+    # per-iteration span, exportable as a Chrome trace
+    spans = get_tracer().spans()
+    step_spans = [s for s in spans if s.name == "train/iteration"]
+    assert len(step_spans) == rounds
+    nested = [s for s in spans if s.name.startswith("GBDT::")]
+    assert nested and all(s.depth >= 1 for s in nested)
+    out = str(tmp_path / "trace.json")
+    n = get_tracer().export_chrome_trace(out)
+    assert n == len(spans)
+    json.load(open(out))
+
+
+def test_telemetry_off_keeps_journal_untouched(train_telemetry_env):
+    import lightgbm_tpu as lgb
+
+    path = train_telemetry_env
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(200, 4))
+    y = X[:, 0] * 2.0
+    p = {"objective": "regression", "num_leaves": 7, "verbose": -1,
+         "obs_events_path": path}          # telemetry NOT enabled
+    lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=2)
+    assert not os.path.exists(path)
+    assert obs_metrics.snapshot().get("train.iterations") is None
+
+
+# ---------------------------------------------------------------------------
+# bench-contract static check: every bench script uses the shared writer
+# and ends with the schema summary (satellite of ISSUE 16 — keeps future
+# bench scripts from regressing to bare json.dumps prints)
+def test_every_bench_script_honors_summary_contract():
+    scripts = sorted(glob.glob(os.path.join(REPO, "scripts", "bench_*.py")))
+    assert scripts, "no bench scripts found — wrong repo layout?"
+    offenders = []
+    for path in scripts:
+        src = open(path).read()
+        if "load_obs" not in src or ".summary(" not in src:
+            offenders.append(os.path.basename(path))
+    assert not offenders, (
+        f"bench scripts bypassing the EventLog summary contract: {offenders} "
+        "— route records through bench.load_obs().EventLog and emit the "
+        "final one-JSON-line summary via LOG.summary(...) "
+        "(see docs/OBSERVABILITY.md)")
+
+
+def test_supervisor_loader_is_jax_free():
+    """bench.load_obs + events + report must import WITHOUT jax — the
+    watcher/suite supervisors run while a stage owns the TPU."""
+    import subprocess
+    import sys as _sys
+    code = (
+        "import builtins, sys\n"
+        "real = builtins.__import__\n"
+        "def guard(name, *a, **k):\n"
+        "    if name == 'jax' or name.startswith('jax.'):\n"
+        "        raise AssertionError('supervisor path imported jax')\n"
+        "    return real(name, *a, **k)\n"
+        "builtins.__import__ = guard\n"
+        "sys.path.insert(0, %r)\n"
+        "import bench\n"
+        "obs = bench.load_obs()\n"
+        "log = obs.EventLog(sys.argv[1])\n"
+        "log.emit('probe', ok=True)\n"
+        "loaded = obs.report.load_perf_log(sys.argv[1])\n"
+        "assert loaded['total'] == 1\n"
+        "print('JAXFREE_OK')\n" % REPO)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        r = subprocess.run(
+            [_sys.executable, "-c", code, os.path.join(d, "e.jsonl")],
+            capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "JAXFREE_OK" in r.stdout

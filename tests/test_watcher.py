@@ -257,8 +257,15 @@ def test_watcher_captures_window_stages_in_order(tmp_path):
     head = [r for r in _perf_records(tmp_path)
             if r.get("stage") == "watcher_headline"]
     assert head and head[0]["result"]["unit"] == "Mrow_iters/sec"
-    # and a window summary lands last
-    assert _perf_records(tmp_path)[-1]["stage"] == "watcher_window"
+    # the window summary lands, then the per-window obs-report artifact
+    # (rendered AFTER the summary so the report covers it)
+    tail = [r["stage"] for r in _perf_records(tmp_path)[-2:]]
+    assert tail == ["watcher_window", "watcher_obs_report"]
+    rep = _perf_records(tmp_path)[-1]
+    assert "error" not in rep, rep
+    assert os.path.exists(rep["path"])
+    art = open(rep["path"]).read()
+    assert "watcher_window" in art    # the digest covers the window record
 
 
 def test_watcher_poll_backoff_on_repeated_failure(tmp_path):
