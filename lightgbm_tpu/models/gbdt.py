@@ -62,6 +62,7 @@ class GBDT:
         # telemetry hook (obs_telemetry): None keeps the off path at one
         # attribute check per iteration (<2% overhead budget)
         self._obs = TrainTelemetry(config) if config.obs_telemetry else None
+        self._grow_cost_recorded = False
         self._models: List[Tree] = []
         # deferred host trees: (tree_arrays, shrinkage, bias, iter) tuples
         # whose device->host copies are in flight (see `models` property)
@@ -614,6 +615,11 @@ class GBDT:
                     self._dd.bins, g[k], h[k], row_weight, fmask,
                     key_for_iteration(cfg.seed, it, salt=k + 1),
                     cegb_coupled, cegb_used)
+            if obs is not None and not self._grow_cost_recorded:
+                self._ledger_grow_cost(
+                    self._dd.bins, g[k], h[k], row_weight, fmask,
+                    key_for_iteration(cfg.seed, it, salt=k + 1),
+                    cegb_coupled, cegb_used)
             # ONE host fetch for the whole tree: over a remote-tunnel backend
             # each np.asarray is a ~90ms round-trip, so per-field pulls
             # dominate training time
@@ -728,6 +734,11 @@ class GBDT:
                     tree_arrays, node_assign = self._grow_jit(
                         self._dd.bins, g[k], h[k], row_weight, fmask,
                         key_for_iteration(cfg.seed, it, salt=k + 1), None, None)
+            if (self._obs is not None and not self._grow_cost_recorded
+                    and cap is None):
+                self._ledger_grow_cost(
+                    self._dd.bins, g[k], h[k], row_weight, fmask,
+                    key_for_iteration(cfg.seed, it, salt=k + 1), None, None)
             jax.tree.map(lambda a: a.copy_to_host_async(), tree_arrays)
             bias = (self.init_scores[k]
                     if it == 0 and self.init_scores[k] != 0.0 else 0.0)
@@ -767,6 +778,22 @@ class GBDT:
             return obj.get_gradients_multi(score, self._label_dev, self._weight_dev)
         g, h = obj.get_gradients(score[0], self._label_dev, self._weight_dev)
         return g[None, :], h[None, :]
+
+    def _ledger_grow_cost(self, *args) -> None:
+        """One-time XLA cost/memory capture of the compiled grow program
+        into the obs cost ledger (``train.grow_tree``): re-lowering costs
+        one retrace, ``compile()`` hits the executable cache, and the
+        telemetry loop joins per-iteration grow seconds against it.
+        Never fatal — attribution must not break training."""
+        self._grow_cost_recorded = True
+        try:
+            from ..obs import costs as obs_costs
+            bins = args[0]
+            obs_costs.analyze_jitted(
+                "train.grow_tree", self._grow_jit, *args,
+                rows=int(bins.shape[0]), features=int(bins.shape[1]))
+        except Exception:
+            pass
 
     @functools.cached_property
     def _grow_jit(self):

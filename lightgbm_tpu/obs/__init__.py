@@ -21,6 +21,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
+from . import costs, regress
+from .costs import CostLedger, get_ledger
 from .events import (EventLog, SCHEMA_VERSION, classify_record, make_event,
                      new_run_id, perf_log_path, validate_event)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -31,6 +33,7 @@ __all__ = ["EventLog", "SCHEMA_VERSION", "classify_record", "make_event",
            "new_run_id", "perf_log_path", "validate_event",
            "Counter", "Gauge", "Histogram", "MetricsRegistry",
            "get_registry", "Span", "Tracer", "get_tracer",
+           "costs", "regress", "CostLedger", "get_ledger",
            "TrainTelemetry"]
 
 
@@ -102,6 +105,15 @@ class TrainTelemetry:
                                    self.reservoir).observe(secs)
         rec: Dict[str, Any] = {"iteration": it, "trees": trees,
                                "phase_seconds": phases}
+        # device-memory watermarks (local stats read, no device sync; CPU
+        # publishes none and the helper degrades to {}) + the cost-ledger
+        # wall-time join for the recorded grow program
+        wm = costs.record_watermarks(self.kind, self.metrics)
+        if wm:
+            rec["device_memory"] = wm
+        if "grow_tree" in phases:
+            get_ledger().observe(f"{self.kind}.grow_tree",
+                                 phases["grow_tree"])
         if extra:
             rec.update(extra)
         self.log.emit(f"{self.kind}_iter", **rec)

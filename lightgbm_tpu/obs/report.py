@@ -17,9 +17,12 @@ import json
 import sys
 from typing import Any, Dict, List, Optional
 
+from . import costs as _costs
+from . import regress as _regress
 from .events import classify_record, perf_log_path
 
 __all__ = ["load_perf_log", "summarize", "render_markdown", "render_json",
+           "roofline_rows", "render_roofline", "render_regressions",
            "main"]
 
 
@@ -151,6 +154,93 @@ def render_json(summary: Dict[str, Any]) -> str:
     return json.dumps(summary, indent=2, default=str)
 
 
+# --------------------------------------------------------------------------
+# --roofline: device-truth cost/MFU rows (obs.costs program_cost events)
+# --------------------------------------------------------------------------
+
+def roofline_rows(loaded: Dict[str, Any],
+                  ledger: Optional[Any] = None) -> List[Dict[str, Any]]:
+    """``program_cost`` records from the journal, plus the live in-process
+    ledger's rooflines when one is passed (dedup: live rows win on name)."""
+    rows = [r for r in loaded["events"] + loaded["legacy"]
+            if r.get("event") == _costs.COST_EVENT
+            or r.get("stage") == _costs.COST_EVENT]
+    if ledger is not None:
+        live = {r["program"]: r for r in ledger.rooflines()}
+        rows = [r for r in rows if r.get("program") not in live]
+        rows += list(live.values())
+    return rows
+
+
+def _num(v: Any, scale: float = 1.0, fmt: str = "{:.3g}") -> str:
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return fmt.format(v * scale)
+    return "" if v is None else str(v)
+
+
+def render_roofline(rows: List[Dict[str, Any]]) -> str:
+    lines = ["## Roofline / MFU (XLA cost ledger)", ""]
+    if not rows:
+        lines += ["_no program_cost records (run a bench with the cost "
+                  "ledger enabled, or emit a CostLedger)._", ""]
+        return "\n".join(lines)
+    lines += ["| program | chip | calls | ms/call | GFLOP/s | MFU | "
+              "model MFU | GB/s | AI (F/B) | bound |",
+              "|---|---|---|---|---|---|---|---|---|---|"]
+    for r in rows:
+        lines.append("| {} | {} | {} | {} | {} | {} | {} | {} | {} | {} |"
+                     .format(r.get("program", "?"), r.get("chip", "?"),
+                             r.get("calls", ""),
+                             _num(r.get("seconds_per_call"), 1e3),
+                             _num(r.get("achieved_flops_per_sec"), 1e-9),
+                             _num(r.get("mfu"), fmt="{:.4f}"),
+                             _num(r.get("model_mfu"),
+                                  fmt="{:.4f}") or
+                             _num(r.get("predicted_mfu"), fmt="{:.4f}"),
+                             _num(r.get("achieved_bytes_per_sec"), 1e-9),
+                             _num(r.get("intensity")),
+                             r.get("bound", "")))
+    lines.append("")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# --regressions: sentinel verdicts over journal + BENCH_r* history
+# --------------------------------------------------------------------------
+
+def render_regressions(result: Dict[str, Any], gate: bool = False) -> str:
+    counts = result["counts"]
+    lines = ["## Perf-regression sentinel", "",
+             "- verdicts: " + (", ".join(f"{k}: **{v}**" for k, v in
+                                         sorted(counts.items())) or "none"),
+             f"- gate: {'**REGRESSED**' if result['regressed'] else 'clean'}"
+             + (" (exit nonzero)" if gate and result["regressed"] else ""),
+             ""]
+    shown = [v for v in result["verdicts"] if v["verdict"] != "no-baseline"]
+    hidden = len(result["verdicts"]) - len(shown)
+    if shown:
+        lines += ["| metric | field | backend | shape | verdict | "
+                  "baseline | latest | Δ% | n |",
+                  "|---|---|---|---|---|---|---|---|---|"]
+        for v in shown:
+            verdict = v["verdict"] + (f" ({v['severity']})"
+                                      if v.get("severity") else "")
+            lines.append("| {} | {} | {} | {} | {} | {} | {} | {} | {} |"
+                         .format(v["metric"], v["field"], v["backend"],
+                                 v["shape"] or "-", verdict,
+                                 _num(v.get("baseline_median")),
+                                 _num(v.get("latest")),
+                                 _num(v.get("rel_change"), 100.0,
+                                      "{:+.1f}"),
+                                 v["n_baseline"]))
+    if hidden:
+        lines.append(f"\n_{hidden} series below the "
+                     f"{_regress.MIN_BASELINE}-sample baseline floor "
+                     "(no-baseline)._")
+    lines.append("")
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m lightgbm_tpu obs-report",
@@ -163,20 +253,51 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="write here instead of stdout")
     ap.add_argument("--no-metrics", action="store_true",
                     help="omit the in-process metrics snapshot")
+    ap.add_argument("--roofline", action="store_true",
+                    help="render only the cost-ledger roofline/MFU rows")
+    ap.add_argument("--regressions", action="store_true",
+                    help="render only the perf-regression sentinel verdicts")
+    ap.add_argument("--gate", action="store_true",
+                    help="with --regressions: exit nonzero on any "
+                         "regressed verdict")
+    ap.add_argument("--bench-glob", default=None,
+                    help="history round files for the sentinel "
+                         "(default: BENCH_r*.json beside the journal)")
     args = ap.parse_args(argv)
 
-    snap = None
-    if not args.no_metrics:
-        from .metrics import snapshot as _snapshot
-        snap = _snapshot()
-    data = summarize(load_perf_log(args.path), metrics_snapshot=snap)
-    text = render_markdown(data) if args.format == "md" else render_json(data)
+    rc = 0
+    loaded = load_perf_log(args.path)
+    if args.roofline or args.regressions:
+        # focused sections (CLI/gate mode): no base report around them
+        parts = []
+        payload: Dict[str, Any] = {}
+        if args.roofline:
+            rows = roofline_rows(loaded, ledger=_costs.get_ledger())
+            parts.append(render_roofline(rows))
+            payload["roofline"] = rows
+        if args.regressions:
+            res = _regress.scan(journal_path=loaded["path"],
+                                bench_glob=args.bench_glob)
+            parts.append(render_regressions(res, gate=args.gate))
+            payload["regressions"] = res
+            if args.gate and res["regressed"]:
+                rc = 1
+        text = ("\n".join(parts) if args.format == "md"
+                else json.dumps(payload, indent=2, default=str))
+    else:
+        snap = None
+        if not args.no_metrics:
+            from .metrics import snapshot as _snapshot
+            snap = _snapshot()
+        data = summarize(loaded, metrics_snapshot=snap)
+        text = (render_markdown(data) if args.format == "md"
+                else render_json(data))
     if args.out:
         with open(args.out, "w") as f:
             f.write(text)
     else:
         sys.stdout.write(text)
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

@@ -153,6 +153,24 @@ def total_lanes(name: str, f: int, max_bin: int) -> int:
     return feat_geometry(spec, f, max_bin, padded_bins(max_bin))[1]
 
 
+#: VPU:MXU throughput ratio at the bf16 rate (8x128 VPU lanes vs the
+#: 128x128 MXU) — the normalization of the docs/PERF.md VPU-work model
+VPU_MXU_RATIO = 42.0
+
+
+def predicted_mfu(name: str, f: int, max_bin: int) -> float:
+    """Analytical MFU bound from the VPU-work model (docs/PERF.md
+    "ceiling attack"): per row the kernel does ``6 * lanes`` useful MXU
+    MACs against ``vpu_compares`` one-hot VPU ops at a ~1:42 throughput
+    disadvantage, so the bound is ``MACs / (MACs + 42 * compares)`` —
+    fewer compares per useful MAC raises the roof.  The perf suite and
+    shootout report this next to the achieved MFU so the next window
+    prices each variant's headroom automatically."""
+    macs = 6.0 * total_lanes(name, f, max_bin)
+    compares = float(VARIANTS[name].vpu_compares(f, max_bin, 1))
+    return macs / (macs + VPU_MXU_RATIO * compares)
+
+
 # --------------------------------------------------------------------------
 # contrib implementations (kernel-side bodies)
 # --------------------------------------------------------------------------

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
@@ -41,6 +42,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..config import SERVE_DEFAULT_BUCKETS as DEFAULT_BUCKETS
 from ..models import model_io
 from ..models.gbdt import GBDT
+from ..obs import costs as obs_costs
 from ..ops.ensemble import predict_raw_ensemble, stack_trees
 from ..parallel.mesh import default_mesh
 from ..utils.log import LightGBMError, Log, check
@@ -210,6 +212,13 @@ class PredictorArtifact:
             self._compiled[b] = jitted.lower(self._ens, spec).compile()
             self._in_shardings[b] = xsh
             self.compile_count += 1
+            # the AOT artifact is the one place that already holds every
+            # Compiled: register each bucket program's XLA cost/memory
+            # analysis in the obs cost ledger (predict() joins wall times)
+            obs_costs.get_ledger().record(
+                f"serve.{self.name}.b{b}", self._compiled[b],
+                rows=b, features=self.num_features,
+                num_class=self.num_class)
         Log.debug("PredictorArtifact %s: compiled %d bucket programs %s",
                   self.name, self.compile_count, self.buckets)
 
@@ -247,9 +256,12 @@ class PredictorArtifact:
             with _EXEC_LOCK:
                 # place with the compiled sharding, then hand the buffer
                 # over (donate_argnums lets XLA reuse it in place)
+                t0 = time.perf_counter()
                 xdev = jax.device_put(xp, self._in_shardings[b])
                 raw, trans = self._compiled[b](self._ens, xdev)
                 picked = np.asarray(raw if raw_score else trans)
+                obs_costs.get_ledger().observe(
+                    f"serve.{self.name}.b{b}", time.perf_counter() - t0)
             out[s:s + chunk.shape[0]] = picked[:chunk.shape[0]]
         return out[:, 0] if K == 1 else out
 

@@ -30,6 +30,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..obs import costs as obs_costs
 from ..obs import metrics as obs_metrics
 from ..utils.log import LightGBMError
 
@@ -264,6 +265,9 @@ class MicroBatcher:
             self._hb("batch", batcher=self.name, requests=len(live),
                      rows=int(X.shape[0]))
             out = np.asarray(self._predict(X))
+            # device-memory watermark after each served batch (local stats
+            # read, no sync; degrades to a no-op on CPU backends)
+            obs_costs.record_watermarks("serve")
         except Exception as e:
             for _, fut in live:
                 _fail_future(fut, e)

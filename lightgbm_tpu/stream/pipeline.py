@@ -21,6 +21,7 @@ from typing import Dict, Iterator, NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from ..obs import costs as obs_costs
 from ..obs import metrics as obs_metrics
 from .host_matrix import HostBinMatrix
 
@@ -96,6 +97,8 @@ class RowBlockPipeline:
         self.stats.bytes_h2d += nbytes
         self._m_puts.inc()
         self._m_bytes.inc(nbytes)
+        # HBM watermark per transfer (local stats read, no sync; {} on CPU)
+        obs_costs.record_watermarks("stream")
         return Block(index=i, rows=rows, start=sl.start, bins=bins_dev,
                      extras=dev_extras)
 

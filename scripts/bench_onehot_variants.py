@@ -33,7 +33,10 @@ from bench import load_obs  # noqa: E402
 
 # the watcher points every stage at one results file (WATCHER_PERF_LOG);
 # obs.events owns that resolution now — one writer for every bench
-LOG = load_obs().EventLog.default(echo=True)
+OBS = load_obs()
+LOG = OBS.EventLog.default(echo=True)
+# achieved/peak math: obs.costs is the ONE peak table + MFU formula
+COSTS = OBS.costs
 
 
 def emit(**kv):
@@ -58,13 +61,11 @@ def run_shootout(rows, max_bins, emit=emit, interpret=False):
     import jax.numpy as jnp
     import numpy as np
 
-    import bench
     from lightgbm_tpu.ops import onehot_variants as ov
     from lightgbm_tpu.ops.histogram import HIST_PARITY_TOL, _hist_onehot
 
     F = 28
-    peak = bench._PEAK_BF16_FLOPS.get(
-        jax.devices()[0].device_kind.lower(), 197e12)
+    chip = COSTS.current_chip()
     # Per-entry failures (parity or lowering) are fully recorded as their
     # own ok:false jsonl entries and must NOT fail the stage: a nonzero
     # exit would make the watcher mark the whole onehot_shootout stage
@@ -122,8 +123,12 @@ def run_shootout(rows, max_bins, emit=emit, interpret=False):
                      ms=round(dt * 1e3, 3),
                      # useful-FLOPs MFU vs the bf16 peak: 2 * 6 rows * N *
                      # the dot's actual N-dim (lane packing SHRINKS it)
-                     mfu=round(2.0 * 6 * rows * lanes / dt / peak, 4),
-                     mxu_lanes=lanes,
+                     mfu=round(COSTS.mfu(2.0 * 6 * rows * lanes, dt,
+                                         chip), 4),
+                     # analytical VPU-work-model bound (docs/PERF.md):
+                     # predicted-vs-achieved prices the ceiling attack
+                     predicted_mfu=round(ov.predicted_mfu(name, F, B), 4),
+                     chip=chip, mxu_lanes=lanes,
                      onehot_elems_per_row=spec.vpu_compares(F, B, 1))
                 tally["ok"] += 1
                 if (tally["best"] is None

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Medium validation tier (VERDICT "Next round" #6): the <15-min CPU
+# cross-section — parallel (8-device virtual mesh), frontier grower
+# parity, reference-binary interop, compute-op units — run before a
+# hardware window so a broken tree never burns TPU time.  Appends one
+# green/red record with the wall time to PROGRESS.jsonl so pre-window
+# validation is cheap AND recorded.
+#
+# Usage: scripts/run_medium_tier.sh [extra pytest args...]
+set -u
+cd "$(dirname "$0")/.."
+
+START=$(date +%s)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout -k 10 900 \
+    python -m pytest tests/ -q -m 'medium and not slow' \
+    -p no:cacheprovider --continue-on-collection-errors "$@"
+RC=$?
+WALL=$(( $(date +%s) - START ))
+
+python - "$RC" "$WALL" <<'EOF'
+import json, sys, time
+rc, wall = int(sys.argv[1]), int(sys.argv[2])
+rec = {"ts": round(time.time(), 3), "event": "medium_tier",
+       "green": rc == 0, "rc": rc, "wall_secs": wall,
+       "timed_out": rc == 124}
+with open("PROGRESS.jsonl", "a") as f:
+    f.write(json.dumps(rec) + "\n")
+print(json.dumps(rec))
+EOF
+exit $RC

@@ -77,6 +77,27 @@ def _append_perf(rec: dict) -> None:
         f.write(json.dumps(rec) + "\n")
 
 
+def _append_regress_verdict(stage: str, window_id) -> None:
+    """Post-stage self-judgment: classify the numbers the stage just
+    appended against the journal + BENCH_r* history via the jax-free
+    regression sentinel (obs.regress), so a slower-than-last-window
+    result flags WHILE the window is still open instead of after it
+    closes.  Never fatal — a verdict bug must not cost a captured
+    stage."""
+    try:
+        regress = bench.load_obs().regress
+        res = regress.scan(journal_path=_perf_log_path())
+        _append_perf({"stage": "watcher_regress", "after_stage": stage,
+                      "window_id": window_id, "counts": res["counts"],
+                      "regressed": res["regressed"],
+                      "worst": [v for v in res["verdicts"]
+                                if v["verdict"] == "regressed"][:5]})
+    except Exception as e:
+        _append_perf({"stage": "watcher_regress", "after_stage": stage,
+                      "window_id": window_id,
+                      "error": f"{type(e).__name__}: {e}"[:200]})
+
+
 def _pop_plan_line(path: str) -> "str | None":
     """Pop the first nonempty line of a plan file (test scripting).  The
     watcher runs fakes strictly one at a time, so read-modify-write is
@@ -347,6 +368,7 @@ def run_pipeline(args, j: dict, hb) -> str:
                 if payload:
                     rec["result"] = payload
             _append_perf(rec)
+            _append_regress_verdict(name, j["window_id"])
             save_journal(args.journal, j)
             continue
         # crash or hang: distinguish "this stage is broken" from "the

@@ -59,3 +59,36 @@ def test_script_auc_matches_package_metric():
         m.init(md, n)
         (_, pkg, _), = m.eval(s.astype(np.float64))
         np.testing.assert_allclose(script_auc(y, s), pkg, atol=1e-12)
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_headline", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metric_name_is_self_consistent():
+    """Honest labeling (VERDICT weak #6): the emitted metric must carry the
+    ACTUAL row count and the CPU-fallback condition — a 200k-row fallback
+    run can never print the 1M-row headline name."""
+    bench = _load_bench()
+    assert (bench.metric_name(200_000, True)
+            == "higgs_200k_cpu_fallback_train_throughput")
+    assert bench.metric_name(1_000_000, False) == "higgs_1m_train_throughput"
+    assert "10p5m" in bench.metric_name(10_500_000, False)
+    assert bench.metric_name(12_345, False) == "higgs_12345_train_throughput"
+    # fallback token and size token are independent
+    assert bench.metric_name(1_000_000, True) \
+        == "higgs_1m_cpu_fallback_train_throughput"
+    # the sentinel strips both tokens so renamed series keep their history
+    import sys
+    sys.path.insert(0, REPO)
+    try:
+        import bench as bench_pkg_loader  # noqa: F401  (load_obs host)
+        regress = bench_pkg_loader.load_obs().regress
+    finally:
+        sys.path.pop(0)
+    assert (regress.canonical_metric(bench.metric_name(200_000, True))
+            == regress.canonical_metric(bench.metric_name(1_000_000, False)))

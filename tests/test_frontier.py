@@ -11,6 +11,8 @@ from sklearn.datasets import make_classification, make_regression
 
 import lightgbm_tpu as lgb
 
+pytestmark = pytest.mark.medium
+
 
 def _models(params, X, y, rounds=4, **dskw):
     out = []

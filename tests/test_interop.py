@@ -23,9 +23,11 @@ import lightgbm_tpu as lgb
 
 REF_BIN = os.environ.get("LGBM_REFERENCE_BIN", "/tmp/lgbm_src/lightgbm")
 
-pytestmark = pytest.mark.skipif(
-    not os.access(REF_BIN, os.X_OK),
-    reason="reference binary not built (scripts/build_reference.sh)")
+pytestmark = [
+    pytest.mark.medium,
+    pytest.mark.skipif(
+        not os.access(REF_BIN, os.X_OK),
+        reason="reference binary not built (scripts/build_reference.sh)")]
 
 
 def _write_csv(path, X, y):

@@ -509,3 +509,38 @@ def test_supervisor_loader_is_jax_free():
             capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stderr
     assert "JAXFREE_OK" in r.stdout
+
+
+def test_no_peak_rate_constants_outside_costs():
+    """ONE peak table (ISSUE 18): every MFU / peak-rate figure must price
+    against lightgbm_tpu/obs/costs.py:PEAK_RATES.  Before the cost ledger,
+    bench.py, scripts/tpu_perf_suite.py and scripts/bench_onehot_variants.py
+    each carried a private table and disagreed about what "12% MFU" meant."""
+    import re
+    # multi-digit (or fractional) mantissas with e9..e19 exponents — the
+    # shape of every published peak rate (275e12, 819e9, 3.3e12, ...) but
+    # NOT of unit conversions (/ 1e9) or test literals (1e12)
+    peak_pat = re.compile(
+        r"(\b\d+\.\d+e(?:9|1[0-9])\b"
+        r"|\b\d{2,}e(?:9|1[0-9])\b"
+        r"|PEAK_BF16|_PEAK_FLOPS|PEAK_HBM)")
+    allowed = {os.path.join("lightgbm_tpu", "obs", "costs.py"),
+               os.path.join("tests", "test_obs.py")}
+    offenders = []
+    for root, dirs, files in os.walk(REPO):
+        dirs[:] = [d for d in dirs
+                   if d not in (".git", "__pycache__", ".pytest_cache")]
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, REPO)
+            if rel in allowed:
+                continue
+            for i, line in enumerate(open(path, errors="replace"), 1):
+                code = line.split("#", 1)[0]
+                if peak_pat.search(code):
+                    offenders.append(f"{rel}:{i}: {line.strip()}")
+    assert not offenders, (
+        "peak-rate constants outside obs/costs.py (route through "
+        "PEAK_RATES / costs.mfu):\n" + "\n".join(offenders))

@@ -4,6 +4,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.medium
+
 from lightgbm_tpu.ops.histogram import build_histogram
 from lightgbm_tpu.ops.split import SplitParams, find_best_split, leaf_output
 

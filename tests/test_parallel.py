@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.medium
+
 from lightgbm_tpu.ops.grower import GrowerConfig, grow_tree
 from lightgbm_tpu.ops.split import SplitParams
 from lightgbm_tpu.parallel import default_mesh, make_dp_train_step
