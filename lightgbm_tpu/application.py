@@ -162,7 +162,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return obs_report_main(argv[1:])
     if not argv:
         print("usage: python -m lightgbm_tpu config=<file> [key=value ...]\n"
-              "       python -m lightgbm_tpu obs-report [--format md|json]")
+              "       python -m lightgbm_tpu obs-report [--format md|json] "
+              "[--roofline] [--regressions [--gate]] "
+              "[--health [--health-url HOST:PORT]]")
         return 1
     try:
         Application(parse_argv(argv)).run()

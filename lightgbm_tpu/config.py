@@ -352,6 +352,11 @@ class Config:
     # company, and how many requests may queue before load is shed
     serve_batch_deadline_ms: float = 2.0
     serve_queue_depth: int = 64
+    # serving SLO objectives tracked by the health plane as multi-window
+    # burn rates (docs/OBSERVABILITY.md "Live health & forensics");
+    # 0 = objective disabled
+    serve_slo_p99_ms: float = 0.0
+    serve_slo_error_rate: float = 0.0
 
     # -- observability (lightgbm_tpu/obs, docs/OBSERVABILITY.md) --
     # master switch for training-loop telemetry: per-iteration structured
@@ -367,6 +372,17 @@ class Config:
     obs_trace_device: bool = False
     # uniform-reservoir size of the rolling-percentile (p50/p99) histograms
     obs_reservoir_size: int = 512
+    # live health plane (obs/health.py): serve /metrics (Prometheus text)
+    # and /healthz (JSON) from a background thread on 127.0.0.1:<port>.
+    # 0 = off; the LGBM_OBS_HEALTH_PORT env var (exported by the watcher
+    # to its stages) enables it too
+    obs_health_port: int = 0
+    # numeric divergence sentinels: every this many boosting rounds sample
+    # device-side isfinite/max-abs reductions over gradients, hessians and
+    # leaf values, emit a numeric_health event and raise DivergenceError
+    # on NaN/Inf.  Rides the async tree materialization — no extra device
+    # sync on the healthy path.  0 = off
+    obs_health_check_iters: int = 0
 
     # unknown keys seen during parsing (kept for model-file round trip)
     _unknown: Dict[str, Any] = field(default_factory=dict, repr=False)
@@ -481,6 +497,14 @@ class Config:
 
         if self.obs_reservoir_size < 1:
             raise LightGBMError("obs_reservoir_size must be >= 1")
+        if not 0 <= self.obs_health_port < 65536:
+            raise LightGBMError("obs_health_port must be in [0, 65535]")
+        if self.obs_health_check_iters < 0:
+            raise LightGBMError("obs_health_check_iters must be >= 0")
+        if self.serve_slo_p99_ms < 0:
+            raise LightGBMError("serve_slo_p99_ms must be >= 0")
+        if not 0 <= self.serve_slo_error_rate < 1:
+            raise LightGBMError("serve_slo_error_rate must be in [0, 1)")
 
         if self.max_bin_matrix_bytes < 0:
             raise LightGBMError("max_bin_matrix_bytes must be >= 0")

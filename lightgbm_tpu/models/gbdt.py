@@ -12,6 +12,7 @@ learner is the single-program grower in ``ops/grower.py``.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -21,6 +22,7 @@ import numpy as np
 from ..config import Config
 from ..io.dataset import Dataset, DeviceData
 from ..obs import TrainTelemetry
+from ..obs import health as obs_health
 from ..metric import create_metrics
 from ..objective import ObjectiveFunction, create_objective
 from ..ops.grower import GrowerConfig, TreeArrays, grow_tree
@@ -62,10 +64,26 @@ class GBDT:
         # telemetry hook (obs_telemetry): None keeps the off path at one
         # attribute check per iteration (<2% overhead budget)
         self._obs = TrainTelemetry(config) if config.obs_telemetry else None
+        # live health plane: numeric sentinels every N rounds + the
+        # /metrics //healthz exposition server (obs_health_port or the
+        # LGBM_OBS_HEALTH_PORT env var the watcher exports to stages)
+        self._health_every = int(
+            getattr(config, "obs_health_check_iters", 0) or 0)
+        server = obs_health.maybe_start(
+            getattr(config, "obs_health_port", 0))
+        self._health_enabled = bool(server is not None or self._health_every)
+        if self._health_enabled and os.environ.get("LGBM_FLIGHT_DIR"):
+            # supervised stage (run_stage exports the dir): arm the flight
+            # recorder so a divergence or kill leaves forensics even when
+            # obs_telemetry is off
+            from ..obs import flight as obs_flight
+            obs_flight.install()
+        self._health_jit = None
         self._grow_cost_recorded = False
         self._models: List[Tree] = []
-        # deferred host trees: (tree_arrays, shrinkage, bias, iter) tuples
-        # whose device->host copies are in flight (see `models` property)
+        # deferred host trees: (tree_arrays, shrinkage, bias, iter,
+        # health_stats-or-None) tuples whose device->host copies are in
+        # flight (see `models` property)
         self._pending: List[tuple] = []
         self._stop_flag = False
         self._empty_by_iter: Dict[int, int] = {}
@@ -111,8 +129,13 @@ class GBDT:
         """Materialize pending device trees (oldest first), leaving at most
         ``keep`` in flight."""
         while len(self._pending) > keep:
-            arrs, shrink, bias, _it = self._pending.pop(0)
+            arrs, shrink, bias, _it, health_dev = self._pending.pop(0)
             host = jax.device_get(arrs)
+            if health_dev is not None:
+                # sentinel scalars rode the same async materialization —
+                # by now they are computed+copied, so this is a cheap host
+                # read, not a new device sync
+                self._run_numeric_check(_it, health_dev)
             nl = int(host.num_leaves)
             if self._obs is not None:
                 self._obs.tree_event(_it, num_leaves=nl, split_gains=[
@@ -624,6 +647,10 @@ class GBDT:
             # each np.asarray is a ~90ms round-trip, so per-field pulls
             # dominate training time
             tree_host = jax.device_get(tree_arrays)
+            if self._health_due(it, k):
+                # the slow path already syncs per tree; check in line
+                self._run_numeric_check(it, self._health_stats_fn()(
+                    g[k], h[k], tree_arrays.leaf_value))
             self._cegb_update(tree_host, node_assign, bag_mask)
             nl = int(tree_host.num_leaves)
             if obs is not None:
@@ -698,6 +725,8 @@ class GBDT:
         if obs is not None:
             obs.tracer.end("train/iteration")
             obs.iteration_event(it, trees=K)
+        elif self._health_enabled:
+            obs_health.set_status(stage="train", iteration=it)
         if should_stop:
             Log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
@@ -740,9 +769,17 @@ class GBDT:
                     self._dd.bins, g[k], h[k], row_weight, fmask,
                     key_for_iteration(cfg.seed, it, salt=k + 1), None, None)
             jax.tree.map(lambda a: a.copy_to_host_async(), tree_arrays)
+            health_dev = None
+            if self._health_due(it, k):
+                # sentinel reductions ride the same async materialization:
+                # dispatched now, judged at drain time — no new device sync
+                health_dev = self._health_stats_fn()(
+                    g[k], h[k], tree_arrays.leaf_value)
+                jax.tree.map(lambda a: a.copy_to_host_async(), health_dev)
             bias = (self.init_scores[k]
                     if it == 0 and self.init_scores[k] != 0.0 else 0.0)
-            self._pending.append((tree_arrays, self.shrinkage_rate, bias, it))
+            self._pending.append((tree_arrays, self.shrinkage_rate, bias, it,
+                                  health_dev))
             with global_timer.scope("GBDT::update_score"):
                 gate = tree_arrays.num_leaves > 1
                 delta = tree_arrays.leaf_value * self.shrinkage_rate
@@ -762,6 +799,8 @@ class GBDT:
             # must not add a device sync to the fast path
             self._obs.tracer.end("train/iteration")
             self._obs.iteration_event(it, trees=K)
+        elif self._health_enabled:
+            obs_health.set_status(stage="train", iteration=it)
         # keep one iteration in flight: draining then blocks only on the
         # PREVIOUS iteration's device work (host stays a full iteration
         # ahead) and its async device->host copy has typically landed, so
@@ -769,6 +808,44 @@ class GBDT:
         # is therefore one iteration late (at most K extra constant trees).
         self._drain_pending(keep=K)
         return self._stop_flag
+
+    # ------------------------------------------------------------------
+    # numeric health sentinels (obs_health_check_iters): tiny device-side
+    # isfinite/max-abs reductions over gradients, hessians and leaf values
+    def _health_stats_fn(self):
+        if self._health_jit is None:
+            @jax.jit
+            def stats(g, h, leaf):
+                def s(x):
+                    xf = jnp.asarray(x, jnp.float32).ravel()
+                    finite = jnp.isfinite(xf)
+                    return jnp.stack([
+                        jnp.mean(finite.astype(jnp.float32)),
+                        jnp.max(jnp.where(finite, jnp.abs(xf), 0.0))])
+                return s(g), s(h), s(leaf)
+            self._health_jit = stats
+        return self._health_jit
+
+    def _health_due(self, it: int, k: int) -> bool:
+        """Sample one tree (k==0) every ``obs_health_check_iters`` rounds."""
+        return bool(self._health_every and k == 0
+                    and it % self._health_every == 0)
+
+    def _run_numeric_check(self, it: int, health_dev) -> None:
+        """Judge fetched sentinel scalars; raises DivergenceError on
+        NaN/Inf (with a flight dump) via ``obs.health.check_numeric``."""
+        g_s, h_s, l_s = jax.device_get(health_dev)
+        stats = {
+            "grad": {"finite_frac": float(g_s[0]),
+                     "max_abs": float(g_s[1])},
+            "hess": {"finite_frac": float(h_s[0]),
+                     "max_abs": float(h_s[1])},
+            "leaf_value": {"finite_frac": float(l_s[0]),
+                           "max_abs": float(l_s[1])},
+        }
+        obs_health.check_numeric(
+            stats, iteration=it, kind="train",
+            log=self._obs.log if self._obs is not None else None)
 
     def _compute_gradients(self, score):
         obj = self.objective

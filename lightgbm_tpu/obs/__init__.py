@@ -19,12 +19,15 @@ tracer, and the ``global_timer`` -> tracer span bridge.
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional
 
-from . import costs, regress
+from . import costs, flight, health, regress
 from .costs import CostLedger, get_ledger
 from .events import (EventLog, SCHEMA_VERSION, classify_record, make_event,
                      new_run_id, perf_log_path, validate_event)
+from .flight import FlightRecorder
+from .health import DivergenceError, SLOMonitor
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       get_registry)
 from .tracer import Span, Tracer, get_tracer
@@ -34,7 +37,8 @@ __all__ = ["EventLog", "SCHEMA_VERSION", "classify_record", "make_event",
            "Counter", "Gauge", "Histogram", "MetricsRegistry",
            "get_registry", "Span", "Tracer", "get_tracer",
            "costs", "regress", "CostLedger", "get_ledger",
-           "TrainTelemetry"]
+           "flight", "health", "FlightRecorder", "DivergenceError",
+           "SLOMonitor", "TrainTelemetry"]
 
 
 class TrainTelemetry:
@@ -71,6 +75,13 @@ class TrainTelemetry:
         self._timer = global_timer
         global_timer.attach_tracer(self.tracer)
         self._phase_base: Dict[str, float] = {}
+        # health plane: arm the flight recorder (dump lands beside the
+        # journal unless LGBM_FLIGHT_DIR redirects it), publish the run
+        # on the status board, start the exposition server when enabled
+        flight.install(dir=os.path.dirname(os.path.abspath(self.log.path)),
+                       run_id=self.run_id)
+        health.set_status(run_id=self.run_id, stage=self.kind)
+        health.maybe_start(getattr(config, "obs_health_port", 0))
 
     # ------------------------------------------------------------------
     def step(self, it: int):
@@ -117,6 +128,13 @@ class TrainTelemetry:
         if extra:
             rec.update(extra)
         self.log.emit(f"{self.kind}_iter", **rec)
+        health.set_status(stage=self.kind, iteration=it)
+        # surface the tracer's silent data loss once per overflow episode
+        if self.tracer.dropped and not self.tracer.overflow_reported:
+            self.tracer.overflow_reported = True
+            self.log.emit("tracer_overflow", level="warning",
+                          dropped=self.tracer.dropped,
+                          capacity=self.tracer.capacity)
 
     def tree_event(self, it: int, *, num_leaves: int,
                    split_gains: Optional[List[float]] = None) -> None:

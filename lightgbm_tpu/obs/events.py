@@ -128,6 +128,36 @@ class EventLog:
     _defaults: Dict[str, "EventLog"] = {}
     _defaults_lock = threading.Lock()
 
+    #: process-wide record taps (the flight recorder's ring).  Class level
+    #: on purpose: a dump must see events from EVERY log in the process
+    #: (telemetry journal + serve log + ad-hoc EventLogs), and observers
+    #: outlive any single log instance.
+    _observers: List[Any] = []
+
+    @classmethod
+    def add_observer(cls, fn: Any) -> None:
+        """Register ``fn(rec)`` to be called (outside the write lock) with
+        every record any :class:`EventLog` in the process appends.
+        Observer exceptions are swallowed — a broken tap must never break
+        the journal."""
+        with cls._defaults_lock:
+            if fn not in cls._observers:
+                cls._observers.append(fn)
+
+    @classmethod
+    def remove_observer(cls, fn: Any) -> None:
+        with cls._defaults_lock:
+            if fn in cls._observers:
+                cls._observers.remove(fn)
+
+    @classmethod
+    def _notify(cls, rec: Dict[str, Any]) -> None:
+        for fn in list(cls._observers):
+            try:
+                fn(rec)
+            except Exception:
+                pass
+
     def __init__(self, path: Optional[str] = None, *,
                  run_id: Optional[str] = None, echo: bool = False):
         self.path = path or perf_log_path()
@@ -169,7 +199,20 @@ class EventLog:
         """Emit a bench script's final summary: appended to the log AND
         printed as the last stdout line (the one-JSON-line contract,
         ``supervise.extract_json_line``).  Validates before writing so a
-        malformed summary fails the bench loudly, not the reader later."""
+        malformed summary fails the bench loudly, not the reader later.
+
+        Surfaces the tracer's silent data loss: when the process tracer has
+        dropped spans (ring overflow) the summary carries a
+        ``tracer_dropped`` count so no bench can claim complete span
+        coverage it doesn't have."""
+        if "tracer_dropped" not in fields:
+            try:  # lazy: keep module import order free of cycles
+                from .tracer import get_tracer
+                dropped = get_tracer().dropped
+            except Exception:
+                dropped = 0
+            if dropped:
+                fields["tracer_dropped"] = dropped
         rec = make_event(SUMMARY_EVENT, self.run_id, **fields)
         errs = validate_event(rec)
         if errs:
@@ -178,6 +221,7 @@ class EventLog:
         with self._lock:
             self._append_line(line)
         print(line, flush=True)
+        self._notify(rec)
         return rec
 
     # ------------------------------------------------------------------
@@ -187,6 +231,7 @@ class EventLog:
             self._append_line(line)
         if self.echo:
             print(line, flush=True)
+        self._notify(rec)
 
     def _append_line(self, line: str) -> None:
         d = os.path.dirname(self.path)

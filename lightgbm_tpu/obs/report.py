@@ -23,7 +23,7 @@ from .events import classify_record, perf_log_path
 
 __all__ = ["load_perf_log", "summarize", "render_markdown", "render_json",
            "roofline_rows", "render_roofline", "render_regressions",
-           "main"]
+           "render_health", "main"]
 
 
 def load_perf_log(path: Optional[str] = None) -> Dict[str, Any]:
@@ -65,7 +65,8 @@ def _is_summary(rec: Dict[str, Any]) -> bool:
 
 def summarize(loaded: Dict[str, Any],
               metrics_snapshot: Optional[Dict[str, Any]] = None,
-              last_n: int = 12) -> Dict[str, Any]:
+              last_n: int = 12,
+              tracer_info: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Aggregate the classified journal into the report's data model."""
     records = loaded["legacy"] + loaded["events"]
     by_stage: Dict[str, int] = {}
@@ -93,6 +94,7 @@ def summarize(loaded: Dict[str, Any],
         "recent_summaries": summaries[-last_n:],
         "windows": windows[-last_n:],
         "metrics": metrics_snapshot or {},
+        "tracer": tracer_info or {},
     }
 
 
@@ -117,6 +119,16 @@ def render_markdown(summary: Dict[str, Any]) -> str:
     ts = summary["ts_range"]
     if ts[0] is not None:
         lines.append(f"- wall-clock span: {ts[1] - ts[0]:.0f} s")
+    tr = summary.get("tracer") or {}
+    if tr:
+        # the ring drops silently when full — the report is where that
+        # data loss must become visible
+        line = (f"- tracer: {tr.get('spans', 0)} span(s) recorded, "
+                f"{tr.get('open_spans', 0)} open")
+        if tr.get("dropped"):
+            line += (f", **{tr['dropped']} dropped** "
+                     f"(ring capacity {tr.get('capacity', '?')})")
+        lines.append(line)
     lines += ["", "## Records by kind", "",
               "| kind | count |", "|---|---|"]
     for stage, n in summary["by_stage"].items():
@@ -241,6 +253,86 @@ def render_regressions(result: Dict[str, Any], gate: bool = False) -> str:
     return "\n".join(lines)
 
 
+# --------------------------------------------------------------------------
+# --health: runtime health plane (live /healthz or in-process snapshot)
+# --------------------------------------------------------------------------
+
+def _health_data(url: Optional[str] = None) -> Dict[str, Any]:
+    """The health payload: fetched from a live process's ``/healthz`` when
+    ``--health-url`` is given, else this process's own snapshot (useful
+    right after an in-process run, or for the flight/tracer state)."""
+    if url:
+        import urllib.request
+        if "://" not in url:
+            url = "http://" + url
+        if not url.rstrip("/").endswith("/healthz"):
+            url = url.rstrip("/") + "/healthz"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return json.loads(resp.read().decode())
+    from . import health as _health
+    return _health.health_snapshot()
+
+
+def render_health(data: Dict[str, Any]) -> str:
+    lines = ["## Runtime health", "",
+             f"- ok: {'**yes**' if data.get('ok') else '**NO**'}"
+             f" (pid {data.get('pid', '?')}, "
+             f"uptime {_num(data.get('uptime_s'))} s)"]
+    if data.get("error"):
+        lines.append(f"- fetch error: {data['error']} "
+                     f"(url: {data.get('url')})")
+        lines.append("")
+        return "\n".join(lines)
+    for key in ("run_id", "stage", "iteration"):
+        if data.get(key) is not None:
+            lines.append(f"- {key}: `{data[key]}`")
+    if data.get("last_event_ts") is not None:
+        lines.append(f"- last event ts: {_num(data['last_event_ts'])}")
+    tr = data.get("tracer") or {}
+    if tr:
+        lines.append(f"- tracer: {tr.get('spans', 0)} span(s), "
+                     f"{tr.get('open_spans', 0)} open, "
+                     f"{tr.get('dropped', 0)} dropped")
+    fl = data.get("flight") or {}
+    if fl:
+        lines.append(f"- flight recorder: {fl.get('events', 0)} event(s) "
+                     f"in ring, {fl.get('dumps', 0)} dump(s) -> "
+                     f"`{fl.get('path', '?')}`")
+    status = data.get("status") or {}
+    numeric = {k: v for k, v in status.items()
+               if k.startswith(("numeric", "last_numeric"))}
+    if numeric:
+        lines.append("- numeric sentinels: "
+                     + ", ".join(f"{k}={v}"
+                                 for k, v in sorted(numeric.items())))
+    dm = data.get("device_memory") or {}
+    if dm:
+        lines += ["", "### Device memory watermarks", "",
+                  "| gauge | bytes |", "|---|---|"]
+        for name, v in dm.items():
+            lines.append(f"| {name} | {_num(v)} |")
+    slos = data.get("slo") or []
+    if slos:
+        lines += ["", "### Serve SLO burn rates", "",
+                  "| model | window | requests | error_rate | p99_ms | "
+                  "error burn | latency burn | breached |",
+                  "|---|---|---|---|---|---|---|---|"]
+        for rep in slos:
+            for wname, w in (rep.get("windows") or {}).items():
+                lines.append(
+                    "| {} | {} | {} | {} | {} | {} | {} | {} |".format(
+                        rep.get("model", "?"), wname,
+                        w.get("requests", 0), _num(w.get("error_rate")),
+                        _num(w.get("p99_ms")), _num(w.get("error_burn")),
+                        _num(w.get("latency_burn")),
+                        "**yes**" if w.get("breached") else "no"))
+    else:
+        lines.append("- serve SLO: no objectives registered "
+                     "(`serve_slo_p99_ms` / `serve_slo_error_rate`)")
+    lines.append("")
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m lightgbm_tpu obs-report",
@@ -257,6 +349,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="render only the cost-ledger roofline/MFU rows")
     ap.add_argument("--regressions", action="store_true",
                     help="render only the perf-regression sentinel verdicts")
+    ap.add_argument("--health", action="store_true",
+                    help="render only the runtime-health section (status "
+                         "board, sentinels, SLO burn rates, flight state)")
+    ap.add_argument("--health-url", default=None, metavar="HOST:PORT",
+                    help="with --health: fetch /healthz from a live "
+                         "process instead of this process's snapshot")
     ap.add_argument("--gate", action="store_true",
                     help="with --regressions: exit nonzero on any "
                          "regressed verdict")
@@ -267,7 +365,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     rc = 0
     loaded = load_perf_log(args.path)
-    if args.roofline or args.regressions:
+    if args.roofline or args.regressions or args.health:
         # focused sections (CLI/gate mode): no base report around them
         parts = []
         payload: Dict[str, Any] = {}
@@ -282,6 +380,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             payload["regressions"] = res
             if args.gate and res["regressed"]:
                 rc = 1
+        if args.health:
+            try:
+                hdata = _health_data(args.health_url)
+            except OSError as e:
+                hdata = {"ok": False, "error": str(e),
+                         "url": args.health_url}
+            parts.append(render_health(hdata))
+            payload["health"] = hdata
         text = ("\n".join(parts) if args.format == "md"
                 else json.dumps(payload, indent=2, default=str))
     else:
@@ -289,7 +395,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not args.no_metrics:
             from .metrics import snapshot as _snapshot
             snap = _snapshot()
-        data = summarize(loaded, metrics_snapshot=snap)
+        tracer_info = None
+        try:
+            from .tracer import get_tracer
+            t = get_tracer()
+            if t.spans() or t.dropped or t.open_spans():
+                tracer_info = {"spans": len(t.spans()),
+                               "open_spans": len(t.open_spans()),
+                               "dropped": t.dropped,
+                               "capacity": t.capacity}
+        except Exception:
+            pass
+        data = summarize(loaded, metrics_snapshot=snap,
+                         tracer_info=tracer_info)
         text = (render_markdown(data) if args.format == "md"
                 else render_json(data))
     if args.out:

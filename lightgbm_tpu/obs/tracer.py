@@ -71,14 +71,37 @@ class Tracer:
         self._lock = threading.Lock()
         self._spans: List[Span] = []
         self.dropped = 0
+        #: set by the first ``tracer_overflow`` warning event so the
+        #: warning fires once per overflow episode, not per iteration
+        self.overflow_reported = False
         self._local = threading.local()
+        # tid -> that thread's open-span stack; thread-locals are not
+        # enumerable from another thread, and the flight recorder needs
+        # the open spans of EVERY thread at crash time
+        self._stacks: Dict[int, List[_OpenSpan]] = {}
 
     # ------------------------------------------------------------------
     def _stack(self) -> List[_OpenSpan]:
         st = getattr(self._local, "stack", None)
         if st is None:
             st = self._local.stack = []
+            with self._lock:
+                self._stacks[threading.get_ident()] = st
         return st
+
+    def open_spans(self) -> List[Dict[str, Any]]:
+        """Snapshot of every thread's currently-open spans (crash
+        forensics: what was in flight when the process died)."""
+        now = time.perf_counter()
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            stacks = {tid: list(st) for tid, st in self._stacks.items()}
+        for tid, stack in sorted(stacks.items()):
+            for depth, o in enumerate(stack):
+                out.append({"name": o.name, "tid": tid, "depth": depth,
+                            "age_s": round(now - o.start, 6),
+                            "args": o.args})
+        return out
 
     def _device_annotation(self, name: str, step: Optional[int] = None):
         """Enter a jax profiler annotation when asked and available."""
@@ -164,6 +187,7 @@ class Tracer:
         with self._lock:
             self._spans.clear()
             self.dropped = 0
+            self.overflow_reported = False
 
     # ------------------------------------------------------------------
     def export_chrome_trace(self, path: str) -> int:

@@ -23,6 +23,7 @@ broken and fails pending futures instead of hanging their callers.
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 import time
 from concurrent.futures import Future
@@ -61,7 +62,8 @@ class MicroBatcher:
     def __init__(self, predict_fn: Callable[[np.ndarray], np.ndarray], *,
                  max_batch_rows: int = 262144, deadline_ms: float = 2.0,
                  queue_depth: int = 64, name: str = "default",
-                 num_features: Optional[int] = None, heartbeat=None):
+                 num_features: Optional[int] = None, heartbeat=None,
+                 slo=None):
         if max_batch_rows < 1:
             raise LightGBMError("max_batch_rows must be >= 1")
         if deadline_ms < 0:
@@ -78,6 +80,10 @@ class MicroBatcher:
         self.queue_depth = int(queue_depth)
         self.name = name
         self._hb = heartbeat or (lambda event, **kv: None)
+        # optional obs.health.SLOMonitor: fed one observation per request
+        # outcome (latency on success, bad=True on shed/error) so the
+        # health plane tracks multi-window burn rates per model
+        self.slo = slo
         self._q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
         self._closed = False
         # makes submit's closed-check atomic with close()'s flag flip: a
@@ -92,6 +98,7 @@ class MicroBatcher:
         # per-batcher equivalent and are the online p50-p99 source
         self._m_requests = obs_metrics.counter("serve.requests")
         self._m_shed = obs_metrics.counter("serve.shed")
+        self._m_errors = obs_metrics.counter("serve.errors")
         self._m_qdepth = obs_metrics.gauge("serve.queue_depth")
         self._m_batch_rows = obs_metrics.histogram("serve.batch_rows")
         self._m_batch_reqs = obs_metrics.histogram("serve.batch_requests")
@@ -132,6 +139,8 @@ class MicroBatcher:
             except queue.Full:
                 self.stats["shed"] += 1
                 self._m_shed.inc()
+                if self.slo is not None:
+                    self.slo.observe(bad=True)
                 self._hb("shed", batcher=self.name, pending=self._q.qsize())
                 raise QueueSaturatedError(
                     f"serving queue {self.name!r} saturated "
@@ -155,8 +164,19 @@ class MicroBatcher:
         (enqueue -> result) is the caller-observed online latency feeding
         ``serve.request_ms`` p50-p99."""
         t0 = time.perf_counter()
-        out = self.submit(X).result(timeout)
-        self._m_request_ms.observe((time.perf_counter() - t0) * 1e3)
+        try:
+            out = self.submit(X).result(timeout)
+        except Exception:
+            # sheds already fed the monitor in submit(); anything else
+            # (worker error, timeout) is a bad request outcome too
+            if self.slo is not None and not isinstance(
+                    sys.exc_info()[1], QueueSaturatedError):
+                self.slo.observe(bad=True)
+            raise
+        ms = (time.perf_counter() - t0) * 1e3
+        self._m_request_ms.observe(ms)
+        if self.slo is not None:
+            self.slo.observe(latency_ms=ms)
         return out
 
     def close(self, timeout: float = 10.0) -> None:
@@ -269,6 +289,7 @@ class MicroBatcher:
             # read, no sync; degrades to a no-op on CPU backends)
             obs_costs.record_watermarks("serve")
         except Exception as e:
+            self._m_errors.inc(len(live))
             for _, fut in live:
                 _fail_future(fut, e)
             return

@@ -32,6 +32,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..obs import health as obs_health
 from ..obs import metrics as obs_metrics
 from ..utils.log import LightGBMError, Log, check
 from .artifact import PredictorArtifact
@@ -43,7 +44,8 @@ __all__ = ["Predictor"]
 class _Entry:
     """One routed model: the live artifact plus swap state."""
 
-    __slots__ = ("artifact", "staged", "previous", "generation", "batcher")
+    __slots__ = ("artifact", "staged", "previous", "generation", "batcher",
+                 "slo")
 
     def __init__(self, artifact: PredictorArtifact):
         self.artifact = artifact
@@ -51,6 +53,7 @@ class _Entry:
         self.previous: Optional[PredictorArtifact] = None
         self.generation = 1
         self.batcher: Optional[MicroBatcher] = None
+        self.slo: Optional[obs_health.SLOMonitor] = None
 
 
 class Predictor:
@@ -93,8 +96,16 @@ class Predictor:
             if ent is None:
                 ent = _Entry(artifact)
                 self._models[name] = ent
+                cfg = artifact._gbdt.config
+                # health plane: exposition server (obs_health_port knob /
+                # LGBM_OBS_HEALTH_PORT env) + per-model SLO objectives
+                obs_health.maybe_start(getattr(cfg, "obs_health_port", 0))
+                p99 = float(getattr(cfg, "serve_slo_p99_ms", 0.0) or 0.0)
+                err = float(getattr(cfg, "serve_slo_error_rate", 0.0) or 0.0)
+                if p99 or err:
+                    ent.slo = obs_health.register_slo(obs_health.SLOMonitor(
+                        name, p99_ms=p99 or None, error_rate=err or None))
                 if self._batching:
-                    cfg = artifact._gbdt.config
                     dl = (self._deadline_ms
                           if self._deadline_ms is not None
                           else getattr(cfg, "serve_batch_deadline_ms", 2.0))
@@ -108,7 +119,7 @@ class Predictor:
                         max_batch_rows=artifact.buckets[-1],
                         deadline_ms=dl, queue_depth=qd, name=name,
                         num_features=artifact.num_features,
-                        heartbeat=self._hb)
+                        heartbeat=self._hb, slo=ent.slo)
             else:
                 ent.previous = ent.artifact
                 ent.artifact = artifact
@@ -237,9 +248,16 @@ class Predictor:
         # direct path (batching off / raw_score): same end-to-end latency
         # histogram the batched path records in MicroBatcher.predict
         t0 = time.perf_counter()
-        out = ent.artifact.predict(X, raw_score=raw_score)
-        obs_metrics.histogram("serve.predict_ms").observe(
-            (time.perf_counter() - t0) * 1e3)
+        try:
+            out = ent.artifact.predict(X, raw_score=raw_score)
+        except Exception:
+            if ent.slo is not None:
+                ent.slo.observe(bad=True)
+            raise
+        ms = (time.perf_counter() - t0) * 1e3
+        obs_metrics.histogram("serve.predict_ms").observe(ms)
+        if ent.slo is not None:
+            ent.slo.observe(latency_ms=ms)
         return out
 
     def submit(self, X, model: Optional[str] = None):
@@ -259,13 +277,17 @@ class Predictor:
                            "num_class": e.artifact.num_class,
                            "buckets": e.artifact.buckets,
                            "staged": e.staged is not None,
-                           "batching": e.batcher is not None}
+                           "batching": e.batcher is not None,
+                           "slo": (e.slo.report()
+                                   if e.slo is not None else None)}
                     for name, e in self._models.items()}
 
     def close(self) -> None:
         self._closed = True
         with self._lock:
-            entries = list(self._models.values())
-        for e in entries:
+            entries = list(self._models.items())
+        for name, e in entries:
             if e.batcher is not None:
                 e.batcher.close()
+            if e.slo is not None:
+                obs_health.unregister_slo(name)

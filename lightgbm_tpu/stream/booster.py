@@ -29,6 +29,7 @@ from ..config import Config
 from ..io.dataset import Dataset
 from ..metric import create_metrics
 from ..models.gbdt import GBDT, bag_mask_from_uniform
+from ..obs import health as obs_health
 from ..models.goss import goss_mask_from_importance
 from ..models.tree import Tree
 from ..objective import create_objective
@@ -119,6 +120,15 @@ def stream_bag_mask(cfg: Config, iteration: int, n_global: int, label_np,
         u = u[lo:hi]
     lab = jnp.asarray(label_np) if label_np is not None else None
     return np.asarray(bag_mask_from_uniform(cfg, u, lab), np.float32)
+
+
+def _finite_stats(a) -> dict:
+    """Host-side sentinel stats (the streaming twin of the device
+    reductions in ``GBDT._health_stats_fn``)."""
+    a = np.asarray(a, np.float32).ravel()
+    finite = np.isfinite(a)
+    mx = float(np.abs(a[finite]).max()) if finite.any() else 0.0
+    return {"finite_frac": float(finite.mean()), "max_abs": mx}
 
 
 class StreamGBDT(GBDT):
@@ -319,6 +329,15 @@ class StreamGBDT(GBDT):
                     g[k], h[k], rw, fmask,
                     key_for_iteration(cfg.seed, it, salt=k + 1))
             nl = int(tree_arrays.num_leaves)
+            if self._health_due(it, k):
+                # streaming gradients/leaves are already host numpy —
+                # check in line (no device round-trip to ride)
+                obs_health.check_numeric(
+                    {"grad": _finite_stats(g[k]),
+                     "hess": _finite_stats(h[k]),
+                     "leaf_value": _finite_stats(tree_arrays.leaf_value)},
+                    iteration=it, kind="stream",
+                    log=obs.log if obs is not None else None)
             if nl > 1:
                 should_stop = False
             if obs is not None:
@@ -363,6 +382,8 @@ class StreamGBDT(GBDT):
         if obs is not None:
             obs.tracer.end("train/iteration")
             obs.iteration_event(it, trees=K)
+        elif self._health_enabled:
+            obs_health.set_status(stage="stream", iteration=it)
         if should_stop:
             Log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")

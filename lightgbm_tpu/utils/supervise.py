@@ -26,13 +26,15 @@ from __future__ import annotations
 import json
 import os
 import random
+import re
+import shutil
 import signal
 import socket
 import subprocess
 import sys
 import tempfile
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 
 # --------------------------------------------------------------------------
@@ -137,15 +139,21 @@ class StageResult:
     attempts: int
     elapsed: float              # wall-clock across all attempts, incl. backoff
     output_tail: str = ""       # merged stdout+stderr tail of the last attempt
+    # flight-recorder dumps collected from a failed child (run_stage's
+    # flight_dir): forensic jsonl files moved beside the caller's journal
+    flight_dumps: "list" = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
         return self.status == "ok"
 
     def to_record(self) -> dict:
-        return {"stage": self.name, "status": self.status,
-                "returncode": self.returncode, "attempts": self.attempts,
-                "elapsed_sec": round(self.elapsed, 3)}
+        rec = {"stage": self.name, "status": self.status,
+               "returncode": self.returncode, "attempts": self.attempts,
+               "elapsed_sec": round(self.elapsed, 3)}
+        if self.flight_dumps:
+            rec["flight_dumps"] = list(self.flight_dumps)
+        return rec
 
 
 def run_stage(name: str, argv: list, timeout: float, retries: int = 0,
@@ -154,6 +162,7 @@ def run_stage(name: str, argv: list, timeout: float, retries: int = 0,
               env: "dict | None" = None, cwd: "str | None" = None,
               heartbeat=None, tail_bytes: int = 8192,
               sleep=time.sleep, rng: "random.Random | None" = None,
+              flight_dir: "str | None" = None,
               ) -> StageResult:
     """Run ``argv`` as a timeout-guarded, crash-isolated stage.
 
@@ -169,41 +178,68 @@ def run_stage(name: str, argv: list, timeout: float, retries: int = 0,
     ``heartbeat`` is any callable accepting ``(event, **fields)`` — see
     :class:`Heartbeat`.  Never raises for child failures; the caller
     branches on ``StageResult.status``.
+
+    ``flight_dir``: arm the child's flight recorder.  Each attempt gets a
+    private scratch dir exported as ``LGBM_FLIGHT_DIR``; when the attempt
+    fails (crash/timeout/unreaped) any ``flight_*.jsonl`` the child's
+    recorder flushed — including the last periodic flush of a SIGKILLed
+    child — is moved into ``flight_dir`` (collision-safe names recorded
+    in ``StageResult.flight_dumps``); an ok attempt's scratch is dropped.
     """
     hb = heartbeat or (lambda event, **kv: None)
     delays = backoff_schedule(retries, backoff, backoff_factor,
                               backoff_cap, jitter, rng)
     t_start = time.monotonic()
     status, rc, tail = "crash", None, ""
+    flight_dumps: list = []
     for attempt in range(retries + 1):
         hb("stage_attempt", stage=name, attempt=attempt,
            argv=list(map(str, argv)), timeout=timeout)
         t_a = time.monotonic()
-        with tempfile.TemporaryFile(mode="w+", errors="replace") as out:
-            try:
-                p = subprocess.Popen(argv, stdout=out,
-                                     stderr=subprocess.STDOUT,
-                                     stdin=subprocess.DEVNULL,
-                                     env=env, cwd=cwd,
-                                     start_new_session=True)
-            except OSError as e:
-                status, rc, tail = "crash", -1, f"spawn failed: {e}"
-                hb("stage_spawn_error", stage=name, attempt=attempt,
-                   error=str(e))
-                break               # argv itself is broken: retrying is noise
-            try:
-                rc = p.wait(timeout)
-                status = "ok" if rc == 0 else "crash"
-            except subprocess.TimeoutExpired:
-                reaped = kill_process_group(p.pid, proc=p)
-                status = "timeout" if reaped else "unreaped"
-                rc = None
-            try:
-                out.seek(0, os.SEEK_END)
-                out.seek(max(0, out.tell() - tail_bytes))
-                tail = out.read()
-            except (OSError, ValueError):
-                tail = ""
+        child_env, flight_tmp = env, None
+        if flight_dir is not None:
+            os.makedirs(flight_dir, exist_ok=True)
+            # scratch INSIDE flight_dir: collection is a same-filesystem
+            # rename, atomic even against a half-written later dump
+            flight_tmp = tempfile.mkdtemp(
+                dir=flight_dir, prefix=f".flight_{_safe_name(name)}_")
+            child_env = dict(os.environ if env is None else env)
+            child_env["LGBM_FLIGHT_DIR"] = flight_tmp
+        try:
+            with tempfile.TemporaryFile(mode="w+", errors="replace") as out:
+                try:
+                    p = subprocess.Popen(argv, stdout=out,
+                                         stderr=subprocess.STDOUT,
+                                         stdin=subprocess.DEVNULL,
+                                         env=child_env, cwd=cwd,
+                                         start_new_session=True)
+                except OSError as e:
+                    status, rc, tail = "crash", -1, f"spawn failed: {e}"
+                    hb("stage_spawn_error", stage=name, attempt=attempt,
+                       error=str(e))
+                    break           # argv itself is broken: retrying is noise
+                try:
+                    rc = p.wait(timeout)
+                    status = "ok" if rc == 0 else "crash"
+                except subprocess.TimeoutExpired:
+                    reaped = kill_process_group(p.pid, proc=p)
+                    status = "timeout" if reaped else "unreaped"
+                    rc = None
+                try:
+                    out.seek(0, os.SEEK_END)
+                    out.seek(max(0, out.tell() - tail_bytes))
+                    tail = out.read()
+                except (OSError, ValueError):
+                    tail = ""
+        finally:
+            if flight_tmp is not None:
+                collected = _collect_flight_dumps(
+                    flight_tmp, flight_dir, name, attempt,
+                    keep=status != "ok")
+                flight_dumps.extend(collected)
+                if collected:
+                    hb("stage_flight_dump", stage=name, attempt=attempt,
+                       dumps=collected)
         hb("stage_result", stage=name, attempt=attempt, status=status,
            returncode=rc, secs=round(time.monotonic() - t_a, 3))
         if status == "ok":
@@ -215,7 +251,35 @@ def run_stage(name: str, argv: list, timeout: float, retries: int = 0,
     return StageResult(name=name, status=status, returncode=rc,
                        attempts=attempt + 1,
                        elapsed=time.monotonic() - t_start,
-                       output_tail=tail)
+                       output_tail=tail, flight_dumps=flight_dumps)
+
+
+def _safe_name(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", str(name))
+
+
+def _collect_flight_dumps(tmp: str, dest: str, name: str, attempt: int,
+                          keep: bool) -> list:
+    """Move a failed attempt's ``flight_*.jsonl`` from its scratch dir into
+    ``dest`` under collision-safe names; drop the scratch dir either way."""
+    out: list = []
+    try:
+        files = sorted(f for f in os.listdir(tmp)
+                       if f.startswith("flight_") and f.endswith(".jsonl"))
+    except OSError:
+        files = []
+    if keep:
+        for f in files:
+            target = os.path.join(
+                dest, f"flight_{_safe_name(name)}_a{attempt}_"
+                      f"{f[len('flight_'):]}")
+            try:
+                os.replace(os.path.join(tmp, f), target)
+                out.append(target)
+            except OSError:
+                pass
+    shutil.rmtree(tmp, ignore_errors=True)
+    return out
 
 
 def extract_json_line(text: str):

@@ -7,13 +7,17 @@ stays under a few percent, because iteration events ride host phase-timer
 deltas instead of forcing device syncs (models/gbdt.py keeps its lazy
 ``_pending`` drain).
 
-Times the same training config three ways — telemetry off, telemetry on,
-and telemetry on with Chrome-trace spans kept — and appends one
-schema-stamped summary with the measured overhead percentages.
+Trials are INTERLEAVED (off, on, off, on, ...) so machine drift —
+thermal, other tenants, allocator state — lands on both arms, and each
+arm reports median ± MAD over the repeats.  A few-percent overhead is
+near the noise floor of a shared CPU box, so the summary carries a
+``sign_ambiguous`` verdict: when the arms' MAD bands overlap the
+measured delta, the sign of the overhead is not resolved by this run
+and the number must not be read as a regression (or an improvement).
 
 Usage:
     JAX_PLATFORMS=cpu python scripts/bench_obs_overhead.py \
-        [--rows 100000] [--rounds 8] [--repeats 3]
+        [--rows 100000] [--rounds 8] [--repeats 5]
 """
 import argparse
 import os
@@ -30,6 +34,19 @@ LOG = load_obs().EventLog.default(echo=True)
 
 def emit(**kv):
     LOG.emit(kv.pop("stage", "bench_record"), **kv)
+
+
+def median(xs):
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def mad(xs):
+    """Median absolute deviation — the robust spread for tiny samples
+    where one GC pause would wreck a standard deviation."""
+    m = median(xs)
+    return median([abs(x - m) for x in xs])
 
 
 def train_secs(params, X, y, rounds):
@@ -51,7 +68,7 @@ def main(argv=None):
     ap.add_argument("--feats", type=int, default=16)
     ap.add_argument("--rounds", type=int, default=8)
     ap.add_argument("--leaves", type=int, default=63)
-    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--repeats", type=int, default=5)
     args = ap.parse_args(argv)
 
     import bench
@@ -82,14 +99,26 @@ def main(argv=None):
     for _ in range(max(1, args.repeats)):
         for name, params in configs.items():
             times[name].append(train_secs(params, X, y, args.rounds))
-    med = {k: sorted(v)[len(v) // 2] for k, v in times.items()}
+    med = {k: median(v) for k, v in times.items()}
+    spread = {k: mad(v) for k, v in times.items()}
     overhead_on = (med["on"] - med["off"]) / med["off"] * 100.0
+    # propagate each arm's MAD into the delta (conservative: sum, not
+    # quadrature — MADs of 3-5 samples are too coarse for quadrature)
+    noise_s = spread["on"] + spread["off"]
+    overhead_mad = noise_s / med["off"] * 100.0
+    # when the noise band covers the measured delta, this run cannot even
+    # resolve WHICH arm was faster — say so instead of printing a signed
+    # percentage that a reader (or the regression sentinel) would trust
+    sign_ambiguous = abs(med["on"] - med["off"]) <= noise_s
 
     for name in configs:
         emit(stage="obs_overhead_arm", arm=name, backend=backend,
-             median_s=round(med[name], 4),
+             median_s=round(med[name], 4), mad_s=round(spread[name], 4),
              all_s=[round(t, 4) for t in times[name]])
 
+    note = (f"overhead {overhead_on:+.2f}% ± {overhead_mad:.2f}% (MAD); "
+            + ("sign NOT resolved at this repeat count"
+               if sign_ambiguous else "sign resolved"))
     # one-JSON-line contract: summary() appends to the journal AND prints
     # the schema-stamped record as the LAST stdout line
     LOG.summary(
@@ -99,6 +128,11 @@ def main(argv=None):
                 "repeats": args.repeats,
                 "median_off_s": round(med["off"], 4),
                 "median_on_s": round(med["on"], 4),
+                "mad_off_s": round(spread["off"], 4),
+                "mad_on_s": round(spread["on"], 4),
+                "overhead_mad_pct": round(overhead_mad, 2),
+                "sign_ambiguous": sign_ambiguous,
+                "note": note,
                 "events_path": evpath})
     return 0
 

@@ -6,6 +6,11 @@
 # green/red record with the wall time to PROGRESS.jsonl so pre-window
 # validation is cheap AND recorded.
 #
+# After the tests, the perf-regression sentinel gate runs over the
+# journal (obs-report --regressions --gate, jax-free): a perf
+# regression blocks the tier exactly like a failing test, and the
+# verdict counts land in the same PROGRESS.jsonl record.
+#
 # Usage: scripts/run_medium_tier.sh [extra pytest args...]
 set -u
 cd "$(dirname "$0")/.."
@@ -20,11 +25,32 @@ WALL=$(( $(date +%s) - START ))
 python - "$RC" "$WALL" <<'EOF'
 import json, sys, time
 rc, wall = int(sys.argv[1]), int(sys.argv[2])
+
+# perf-regression sentinel: jax-free load, gate rc folded into the
+# tier verdict (a sentinel crash must not mask a green/red test run,
+# so failures of the GATE ITSELF are recorded but non-fatal)
+gate = {"gate_rc": None, "regressed": None, "verdicts": None}
+try:
+    import io, contextlib
+    import bench
+    obs = bench.load_obs()
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        gate_rc = obs.report.main(["--regressions", "--gate",
+                                   "--format", "json"])
+    res = json.loads(buf.getvalue())["regressions"]
+    gate = {"gate_rc": gate_rc, "regressed": bool(res["regressed"]),
+            "verdicts": res["counts"]}
+except Exception as e:   # noqa: BLE001 - record, don't mask the tests
+    gate["gate_error"] = f"{type(e).__name__}: {e}"
+
+final_rc = rc if rc != 0 else (gate["gate_rc"] or 0)
 rec = {"ts": round(time.time(), 3), "event": "medium_tier",
-       "green": rc == 0, "rc": rc, "wall_secs": wall,
-       "timed_out": rc == 124}
+       "green": final_rc == 0, "rc": rc, "wall_secs": wall,
+       "timed_out": rc == 124, "perf_gate": gate}
 with open("PROGRESS.jsonl", "a") as f:
     f.write(json.dumps(rec) + "\n")
 print(json.dumps(rec))
+sys.exit(final_rc)
 EOF
-exit $RC
+exit $?

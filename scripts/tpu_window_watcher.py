@@ -162,7 +162,32 @@ def _fake_probe() -> int:
     return 1
 
 
+def _arm_fake_flight(name: str):
+    """Arm a flight recorder inside a fake stage when the supervisor
+    exported ``LGBM_FLIGHT_DIR`` (run_stage's flight_dir seam).  Loads the
+    stdlib-only obs package standalone — fake subprocesses must not import
+    bench/numpy/jax.  flush_every=1 so even a SIGKILLed hang leaves its
+    eager flush on disk."""
+    if not os.environ.get("LGBM_FLIGHT_DIR"):
+        return None
+    try:
+        import importlib.util
+        pkg_dir = os.path.join(REPO, "lightgbm_tpu", "obs")
+        spec = importlib.util.spec_from_file_location(
+            "_watcher_fake_obs", os.path.join(pkg_dir, "__init__.py"),
+            submodule_search_locations=[pkg_dir])
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_watcher_fake_obs"] = mod
+        spec.loader.exec_module(mod)
+        rec = mod.flight.install(flush_every=1)
+        rec.note("fake_stage_start", stage=name, pid=os.getpid())
+        return rec
+    except Exception:
+        return None      # forensics must never break the fake itself
+
+
 def _fake_stage(name: str) -> int:
+    flight_rec = _arm_fake_flight(name)
     behavior = None
     plan = os.environ.get("WATCHER_FAKE_STAGE_PLAN")
     if plan:
@@ -179,6 +204,9 @@ def _fake_stage(name: str) -> int:
                 json.dump(table, f)
     if behavior is None:
         behavior = "ok"
+    if flight_rec is not None:
+        flight_rec.note("fake_stage_behavior", stage=name,
+                        behavior=behavior)
     if behavior == "hang":
         _hang_with_grandchild()
         return 1
@@ -322,6 +350,11 @@ def run_pipeline(args, j: dict, hb) -> str:
         save_journal(args.journal, j)
         env = dict(os.environ)
         env["WATCHER_PERF_LOG"] = _perf_log_path()
+        if args.health_port:
+            # stages run strictly one at a time, so a single port serves
+            # whichever stage is live; each stage's loops call
+            # obs.health.maybe_start off this env var
+            env["LGBM_OBS_HEALTH_PORT"] = str(args.health_port)
         env.update(env_over)
         parity_ok = next(s for s in j["stages"]
                          if s["name"] == "parity")["status"] == "ok"
@@ -352,7 +385,10 @@ def run_pipeline(args, j: dict, hb) -> str:
         res = sup.run_stage(name, argv, timeout=timeout,
                             retries=args.stage_retries,
                             backoff=args.stage_backoff,
-                            heartbeat=hb, env=env, cwd=REPO)
+                            heartbeat=hb, env=env, cwd=REPO,
+                            # crashed/hung stages leave their flight
+                            # recorder dumps beside the journal
+                            flight_dir=args.state_dir)
         ent["detail"] = {**res.to_record(), "window_id": j["window_id"],
                          **({"resumed": True} if resumed else {}),
                          # numbers recorded after a parity failure are
@@ -524,6 +560,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "(0 = poll forever)")
     ap.add_argument("--once", action="store_true",
                     help="one poll step (and pipeline, if live) then exit")
+    ap.add_argument("--health-port", type=int,
+                    default=int(os.environ.get("WATCHER_HEALTH_PORT", 0)),
+                    help="export LGBM_OBS_HEALTH_PORT to stages so the "
+                         "live stage serves /metrics //healthz here "
+                         "(0 = off)")
     args = ap.parse_args(argv)
     os.makedirs(args.state_dir, exist_ok=True)
     args.journal = os.path.join(args.state_dir, "watcher_state.json")
